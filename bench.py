@@ -1,0 +1,69 @@
+"""Benchmark: flagship ResNet-20 CIFAR10 training throughput on real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline note: the reference publishes no benchmark tables (BASELINE.md);
+its demo hardware is a single V100-class GPU per worker.  We use an
+estimated 10_000 samples/sec for GeoMX-CUDA ResNet-20/CIFAR10 on one such
+GPU as the per-chip comparison constant, so vs_baseline > 1.0 means one
+TPU chip outruns one reference GPU.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_GPU_SAMPLES_PER_SEC = 10_000.0
+
+
+def main():
+    import os
+
+    import jax
+    if os.environ.get("GEOMX_BENCH_PLATFORM"):  # debug: e.g. "cpu"
+        jax.config.update("jax_platforms", os.environ["GEOMX_BENCH_PLATFORM"])
+    import optax
+
+    from geomx_tpu.models import ResNet20
+    from geomx_tpu.sync import FSA
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    topo = HiPSTopology(num_parties=1, workers_per_party=1)
+    model = ResNet20(num_classes=10)
+    trainer = Trainer(model, topo, optax.sgd(0.1, momentum=0.9), sync=FSA())
+
+    batch = int(os.environ.get("GEOMX_BENCH_BATCH", 1024))
+    rng = np.random.RandomState(0)
+    x = (rng.rand(1, 1, batch, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(1, 1, batch)).astype(np.int32)
+    sharding = topo.batch_sharding(trainer.mesh)
+    xb = jax.device_put(x, sharding)
+    yb = jax.device_put(y, sharding)
+
+    state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+
+    # warmup / compile
+    for _ in range(3):
+        state, metrics = trainer.train_step(state, xb, yb)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = trainer.train_step(state, xb, yb)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    sps = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet20_cifar10_train_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(sps / REFERENCE_GPU_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""geomx_tpu — a TPU-native framework for geo-distributed ML training.
+
+A from-scratch JAX/XLA re-design of the capabilities of GeoMX
+(https://github.com/INET-RC/GeoMX): hierarchical two-tier parameter-server
+training ("HiPS") across data centers, re-expressed as SPMD collectives over a
+2-level TPU device mesh — the intra-party tier rides ICI, the cross-party
+(geo/WAN) tier rides DCN — plus the reference's WAN-communication accelerators
+re-built TPU-first:
+
+- Bi-Sparse top-k gradient sparsification (``compression.bisparse``)
+- FP16 low-precision transmission (``compression.fp16``)
+- Mixed-Precision Quantization / MPQ (``compression.mpq``)
+- 2-bit quantization with error feedback (``compression.twobit``)
+- DGT contribution-aware differential transmission (``sync.dgt``)
+- P3 priority-based parameter propagation (``transport.p3``)
+- TSEngine adaptive communication scheduling (``transport.tsengine``)
+- MultiGPS parameter sharding (``parallel.multigps``)
+
+Synchronization algorithms: FSA (fully-synchronous, default), MixedSync
+(async global tier with optional DCASGD delay compensation), and HFA
+(hierarchical frequency aggregation).
+
+Reference layer map and parity inventory: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from geomx_tpu.topology import HiPSTopology, DC_AXIS, WORKER_AXIS
+from geomx_tpu.config import GeoConfig
+
+__all__ = [
+    "HiPSTopology",
+    "GeoConfig",
+    "DC_AXIS",
+    "WORKER_AXIS",
+    "__version__",
+]

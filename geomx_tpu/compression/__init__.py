@@ -1,0 +1,29 @@
+"""Gradient compression for the cross-tier transfers.
+
+TPU-native re-design of the reference's GradientCompression
+(src/kvstore/gradient_compression.{h,cc}): each compressor implements a
+*compressed all-reduce* over a mesh axis — compress locally, all-gather the
+fixed-size compressed payload across the axis (that gather IS the wire
+transfer), decompress-and-sum locally.  Error-feedback state (residuals /
+velocities) is per-party device-local state threaded through the train step.
+
+Spec-string surface mirrors the reference's "type,threshold" encoding
+(gradient_compression.cc:82-100): "none", "fp16", "2bit,0.5", "bsc,0.01",
+"mpq,0.01,200000".
+"""
+
+from geomx_tpu.compression.base import Compressor, NoCompressor, get_compressor
+from geomx_tpu.compression.fp16 import FP16Compressor
+from geomx_tpu.compression.twobit import TwoBitCompressor
+from geomx_tpu.compression.bisparse import BiSparseCompressor
+from geomx_tpu.compression.mpq import MPQCompressor
+
+__all__ = [
+    "Compressor",
+    "NoCompressor",
+    "FP16Compressor",
+    "TwoBitCompressor",
+    "BiSparseCompressor",
+    "MPQCompressor",
+    "get_compressor",
+]

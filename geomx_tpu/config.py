@@ -1,0 +1,144 @@
+"""Environment-variable configuration surface.
+
+The reference configures its entire distributed topology and every
+communication accelerator through environment variables (reference:
+docs/source/env-var-summary.rst:4-126, parsed in
+3rdparty/ps-lite/src/postoffice.cc:21-53 and
+src/kvstore/kvstore_dist_server.h:181-187).  We keep that surface for
+familiarity: every knob reads ``GEOMX_*`` first and falls back to the
+reference's original ``DMLC_*`` / ``MXNET_*`` name, so reference launch
+scripts translate directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(names, default, cast):
+    """First set env var among `names` wins; else `default`."""
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None and v != "":
+            try:
+                return cast(v)
+            except (TypeError, ValueError):
+                raise ValueError(f"Bad value for env var {n}: {v!r}")
+    return default
+
+
+def _env_bool(names, default):
+    return bool(_env(names, int(default), lambda s: int(float(s))))
+
+
+@dataclasses.dataclass(frozen=True)
+class GeoConfig:
+    """All framework knobs, with reference-compatible env aliases.
+
+    Defaults mirror the reference's defaults (citations inline).
+    """
+
+    # ---- topology (reference: scripts/cpu/run_vanilla_hips.sh role env vars)
+    num_parties: int = 1              # number of data centers (global tier width)
+    workers_per_party: int = 1        # intra-DC workers (local tier width)
+
+    # ---- synchronization algorithm (reference README.md:32-45)
+    #   "fsa" (dist_sync), "mixed" (dist_async [+ dcasgd]), "hfa"
+    sync_mode: str = "fsa"
+    # HFA periods (reference: docs/source/env-var-summary.rst:80-90,
+    # scripts/cpu/run_hfa_sync.sh K1=20 K2=10)
+    hfa_k1: int = 20
+    hfa_k2: int = 10
+    # MixedSync staleness emulation: parties refresh their stale copy of the
+    # global parameters every `mixed_pull_interval` steps.
+    mixed_pull_interval: int = 1
+    # DCASGD is opt-in, as in the reference (examples/cnn.py: --mixed-sync
+    # runs plain Adam; --dcasgd selects the compensating optimizer)
+    dcasgd: bool = False
+    dcasgd_lambda: float = 0.04       # MXNet DCASGD default lamda=0.04
+                                      # (reference python/mxnet/optimizer/optimizer.py:872-925)
+
+    # ---- gradient compression (reference src/kvstore/gradient_compression.cc)
+    # spec strings: "none" | "fp16" | "2bit,<threshold>" | "bsc,<ratio>" | "mpq,<ratio>"
+    compression: str = "none"
+    bsc_threshold: float = 0.01       # -bcr default (reference examples/cnn_bsc.py)
+    twobit_threshold: float = 0.5
+    # MPQ size split: tensors with fewer elements go fp16, larger get BSC
+    # (reference MXNET_KVSTORE_SIZE_LOWER_BOUND default in
+    #  src/kvstore/kvstore_dist_server.h:183; demo uses 200000)
+    size_lower_bound: int = 200_000
+
+    # ---- MultiGPS parameter sharding
+    # tensors >= this many elements are sharded across the global-server axis
+    # (reference MXNET_KVSTORE_BIGARRAY_BOUND, src/kvstore/kvstore_dist.h:69)
+    bigarray_bound: int = 1_000_000
+    multi_gps: bool = False
+
+    # ---- DGT (reference 3rdparty/ps-lite/include/ps/kv_app.h:1036-1045)
+    enable_dgt: int = 0
+    dgt_block_size: int = 4096        # bytes in reference; we use elements/4
+    dgt_k: float = 0.5                # DMLC_K: fraction sent reliably
+    dgt_k_min: float = 0.2            # DMLC_K_MIN (adaptive-K floor)
+    dgt_contri_alpha: float = 0.3     # DGT_CONTRI_ALPHA EWMA factor
+    adaptive_k: bool = False          # ADAPTIVE_K_FLAG
+    udp_channel_num: int = 1          # DMLC_UDP_CHANNEL_NUM
+
+    # ---- P3 (reference ENABLE_P3, src/kvstore/kvstore_dist.h:835-872)
+    enable_p3: bool = False
+    p3_slice_elems: int = 500_000     # bigarray_bound // 2 in the reference
+
+    # ---- TSEngine (reference van.cc:447-454)
+    enable_inter_ts: bool = False
+    enable_intra_ts: bool = False
+    max_greed_rate: float = 0.9       # MAX_GREED_RATE_TS
+
+    # ---- data
+    data_dir: str = "/root/data"      # reference examples/cnn.py:56
+
+    # ---- fault tolerance (reference van.cc:1147-1160)
+    heartbeat_interval_s: float = 0.0  # PS_HEARTBEAT_INTERVAL; 0 disables
+    heartbeat_timeout_s: float = 15.0  # PS_HEARTBEAT_TIMEOUT
+
+    @classmethod
+    def from_env(cls, **overrides) -> "GeoConfig":
+        cfg = dict(
+            num_parties=_env(["GEOMX_NUM_PARTIES", "DMLC_NUM_GLOBAL_WORKER"], 1, int),
+            workers_per_party=_env(["GEOMX_WORKERS_PER_PARTY", "DMLC_NUM_WORKER"], 1, int),
+            sync_mode=_env(["GEOMX_SYNC_MODE"], "fsa", str),
+            hfa_k1=_env(["GEOMX_HFA_K1", "DMLC_K1"], 20, int),
+            hfa_k2=_env(["GEOMX_HFA_K2", "DMLC_K2"], 10, int),
+            mixed_pull_interval=_env(["GEOMX_MIXED_PULL_INTERVAL"], 1, int),
+            dcasgd=_env_bool(["GEOMX_DCASGD"], False),
+            dcasgd_lambda=_env(["GEOMX_DCASGD_LAMBDA"], 0.04, float),
+            compression=_env(["GEOMX_COMPRESSION"], "none", str),
+            bsc_threshold=_env(["GEOMX_BSC_THRESHOLD"], 0.01, float),
+            twobit_threshold=_env(["GEOMX_2BIT_THRESHOLD"], 0.5, float),
+            size_lower_bound=_env(
+                ["GEOMX_SIZE_LOWER_BOUND", "MXNET_KVSTORE_SIZE_LOWER_BOUND"],
+                200_000, int),
+            bigarray_bound=_env(
+                ["GEOMX_BIGARRAY_BOUND", "MXNET_KVSTORE_BIGARRAY_BOUND"],
+                1_000_000, int),
+            multi_gps=_env_bool(["GEOMX_MULTI_GPS"], False),
+            enable_dgt=_env(["GEOMX_ENABLE_DGT", "ENABLE_DGT"], 0, int),
+            dgt_block_size=_env(["GEOMX_DGT_BLOCK_SIZE", "DGT_BLOCK_SIZE"], 4096, int),
+            dgt_k=_env(["GEOMX_DGT_K", "DMLC_K"], 0.5, float),
+            dgt_k_min=_env(["GEOMX_DGT_K_MIN", "DMLC_K_MIN"], 0.2, float),
+            dgt_contri_alpha=_env(["GEOMX_DGT_CONTRI_ALPHA", "DGT_CONTRI_ALPHA"], 0.3, float),
+            adaptive_k=_env_bool(["GEOMX_ADAPTIVE_K", "ADAPTIVE_K_FLAG"], False),
+            udp_channel_num=_env(["GEOMX_UDP_CHANNEL_NUM", "DMLC_UDP_CHANNEL_NUM"], 1, int),
+            enable_p3=_env_bool(["GEOMX_ENABLE_P3", "ENABLE_P3"], False),
+            p3_slice_elems=_env(["GEOMX_P3_SLICE_ELEMS"], 500_000, int),
+            enable_inter_ts=_env_bool(["GEOMX_ENABLE_INTER_TS", "ENABLE_INTER_TS"], False),
+            enable_intra_ts=_env_bool(["GEOMX_ENABLE_INTRA_TS", "ENABLE_INTRA_TS"], False),
+            max_greed_rate=_env(["GEOMX_MAX_GREED_RATE", "MAX_GREED_RATE_TS"], 0.9, float),
+            data_dir=_env(["GEOMX_DATA_DIR"], "/root/data", str),
+            heartbeat_interval_s=_env(
+                ["GEOMX_HEARTBEAT_INTERVAL", "PS_HEARTBEAT_INTERVAL"], 0.0, float),
+            heartbeat_timeout_s=_env(
+                ["GEOMX_HEARTBEAT_TIMEOUT", "PS_HEARTBEAT_TIMEOUT"], 15.0, float),
+        )
+        cfg.update(overrides)
+        return cls(**cfg)

@@ -1,0 +1,8 @@
+"""Data pipeline: datasets, party/worker sharding samplers, host loader."""
+
+from geomx_tpu.data.samplers import SplitSampler, ClassSplitSampler
+from geomx_tpu.data.datasets import load_dataset, DATASETS
+from geomx_tpu.data.loader import GeoDataLoader
+
+__all__ = ["SplitSampler", "ClassSplitSampler", "load_dataset", "DATASETS",
+           "GeoDataLoader"]

@@ -1,0 +1,74 @@
+"""Host-side batched loader for the HiPS topology.
+
+Each (party, worker) cell of the mesh trains on its own shard, produced by
+SplitSampler / ClassSplitSampler exactly as each reference worker process
+loads its slice (examples/utils.py:39-117, cnn.py:100-108).  A global step
+consumes one batch per worker, stacked to
+
+    [num_parties, workers_per_party, local_batch, H, W, C]
+
+and placed with the mesh's (dc, worker) sharding so each device receives
+only its own slice.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from geomx_tpu.data.samplers import SplitSampler, ClassSplitSampler, class_sorted_indices
+from geomx_tpu.topology import HiPSTopology
+
+
+class GeoDataLoader:
+    def __init__(self, x: np.ndarray, y: np.ndarray, topology: HiPSTopology,
+                 batch_size: int, split_by_class: bool = False,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 sharding: Optional[jax.sharding.Sharding] = None):
+        """``batch_size`` is per-worker, matching the reference's -bs flag
+        (each worker process trains batch_size samples per step)."""
+        self.topology = topology
+        self.batch_size = int(batch_size)
+        self.sharding = sharding
+        self.shuffle = shuffle
+        self.seed = seed
+        n_workers = topology.total_workers
+        length = len(x)
+        if split_by_class:
+            order = class_sorted_indices(y)
+            shards = [ClassSplitSampler(order, length, n_workers, i).indices()
+                      for i in range(n_workers)]
+        else:
+            shards = [SplitSampler(length, n_workers, i).indices()
+                      for i in range(n_workers)]
+        self.x, self.y = x, y
+        self.shards = shards
+        self.steps_per_epoch = min(len(s) for s in shards) // self.batch_size
+        if self.steps_per_epoch < 1:
+            raise ValueError(
+                f"shard of {min(len(s) for s in shards)} samples cannot fill "
+                f"a batch of {self.batch_size}")
+
+    def epoch(self, epoch: int = 0) -> Iterator[Tuple[jax.Array, jax.Array]]:
+        """Yield (x, y) global batches for one epoch."""
+        topo = self.topology
+        rng = np.random.RandomState(self.seed + epoch)
+        order = []
+        for s in self.shards:
+            idx = s.copy()
+            if self.shuffle:
+                rng.shuffle(idx)
+            order.append(idx)
+        b = self.batch_size
+        for step in range(self.steps_per_epoch):
+            sel = np.stack([idx[step * b:(step + 1) * b] for idx in order])
+            xb = self.x[sel.reshape(-1)].reshape(
+                (topo.num_parties, topo.workers_per_party, b) + self.x.shape[1:])
+            yb = self.y[sel.reshape(-1)].reshape(
+                (topo.num_parties, topo.workers_per_party, b))
+            if self.sharding is not None:
+                xb = jax.device_put(xb, self.sharding)
+                yb = jax.device_put(yb, self.sharding)
+            yield xb, yb

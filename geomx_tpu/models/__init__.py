@@ -1,0 +1,26 @@
+"""Model zoo for the geo-distributed training workloads.
+
+The reference's demo workloads are Gluon CNNs on MNIST/FashionMNIST/CIFAR10
+(examples/cnn*.py); the flagship target is ResNet on CIFAR10 (BASELINE.md).
+"""
+
+from geomx_tpu.models.cnn import GeoCNN
+from geomx_tpu.models.resnet import ResNet, ResNet20, ResNet32, ResNet56, ResNet18
+
+__all__ = ["GeoCNN", "ResNet", "ResNet20", "ResNet32", "ResNet56", "ResNet18",
+           "get_model"]
+
+
+def get_model(name: str, num_classes: int = 10):
+    name = name.lower()
+    if name in ("cnn", "geocnn", "lenet"):
+        return GeoCNN(num_classes=num_classes)
+    if name == "resnet20":
+        return ResNet20(num_classes=num_classes)
+    if name == "resnet32":
+        return ResNet32(num_classes=num_classes)
+    if name == "resnet56":
+        return ResNet56(num_classes=num_classes)
+    if name == "resnet18":
+        return ResNet18(num_classes=num_classes)
+    raise ValueError(f"Unknown model: {name!r}")

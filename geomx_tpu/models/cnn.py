@@ -1,0 +1,31 @@
+"""The reference demo CNN.
+
+Architecture parity with examples/cnn.py:59-66: Conv(16, 5x5, relu) ->
+MaxPool(2,2) -> Conv(32, 5x5, relu) -> MaxPool(2,2) -> Dense(256, relu) ->
+Dense(128, relu) -> Dense(10), Xavier init.  Inputs are NHWC (TPU-native
+layout; the reference uses NCHW because cuDNN prefers it — XLA on TPU
+prefers channels-last).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class GeoCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        init = nn.initializers.xavier_uniform()
+        x = nn.Conv(16, (5, 5), kernel_init=init)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(32, (5, 5), kernel_init=init)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256, kernel_init=init)(x))
+        x = nn.relu(nn.Dense(128, kernel_init=init)(x))
+        return nn.Dense(self.num_classes, kernel_init=init)(x)

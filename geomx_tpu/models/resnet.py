@@ -1,0 +1,95 @@
+"""ResNet family for the flagship CIFAR10 benchmark (BASELINE.md).
+
+CIFAR-style ResNet-20/32/56 (He et al. 2016, section 4.2: 3 stages of n
+basic blocks at 16/32/64 channels, 3x3 stem) and an ImageNet-style
+ResNet-18 variant.  bfloat16 compute with fp32 parameters/statistics is
+the TPU-native mixed-precision recipe: matmuls/convs hit the MXU at
+bf16 throughput while the optimizer and BatchNorm stay fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False, dtype=self.dtype,
+                    kernel_init=nn.initializers.he_normal())(x)
+        y = self.norm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype,
+                    kernel_init=nn.initializers.he_normal())(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
+                      scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype,
+                               kernel_init=nn.initializers.he_normal())(residual)
+            residual = self.norm(use_running_average=not train,
+                                 dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    stage_filters: Sequence[int]
+    num_classes: int = 10
+    stem_kernel: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = functools.partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5)
+        x = x.astype(self.dtype)
+        k = self.stem_kernel
+        x = nn.Conv(self.stage_filters[0], (k, k), padding="SAME",
+                    use_bias=False, dtype=self.dtype,
+                    kernel_init=nn.initializers.he_normal())(x)
+        x = norm(use_running_average=not train, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for stage, (num_blocks, filters) in enumerate(
+                zip(self.stage_sizes, self.stage_filters)):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(filters, strides=strides, dtype=self.dtype,
+                               norm=norm)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet20(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 3, 3), stage_filters=(16, 32, 64),
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet32(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(5, 5, 5), stage_filters=(16, 32, 64),
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet56(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(9, 9, 9), stage_filters=(16, 32, 64),
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet18(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), stage_filters=(64, 128, 256, 512),
+                  num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,30 @@
+"""Optimizers.
+
+The reference runs its optimizer server-side at the global tier (python
+Adam/DCASGD unpickled into the server's Executor, SURVEY.md §3.3); here
+the optimizer is an optax transform applied identically on every device
+after gradient sync — same math, no server.  DCASGD is the one optimizer
+the reference adds over stock MXNet; it is provided both as a standalone
+optax transform and fused into ``sync.MixedSync``.
+"""
+
+from geomx_tpu.optim.dcasgd import dcasgd
+
+import optax
+
+
+def get_optimizer(name: str, learning_rate=0.01, **kw):
+    """Reference demo defaults: Adam lr 0.01 (examples/cnn.py:32,72)."""
+    name = name.lower()
+    if name == "adam":
+        return optax.adam(learning_rate, **kw)
+    if name == "sgd":
+        return optax.sgd(learning_rate, **kw)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=kw.pop("momentum", 0.9), **kw)
+    if name == "dcasgd":
+        return dcasgd(learning_rate, **kw)
+    raise ValueError(f"Unknown optimizer: {name!r}")
+
+
+__all__ = ["dcasgd", "get_optimizer"]

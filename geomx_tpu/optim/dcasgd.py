@@ -1,0 +1,55 @@
+"""DCASGD — Delay-Compensated Async SGD (Zheng et al., 2017).
+
+Reference implementation: python/mxnet/optimizer/optimizer.py:872-925 —
+per-parameter previous-weight copy; update
+
+    grad += wd * weight
+    mom  *= momentum
+    mom  -= lr * (grad + lamda * grad*grad * (weight - previous_weight))
+    weight += mom
+    previous_weight = weight
+
+Here as an optax GradientTransformation (requires params via
+``update(..., params=...)``).  MXNet defaults: momentum=0.0, lamda=0.04.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class DCASGDState(NamedTuple):
+    momentum: optax.Updates
+    previous_weights: optax.Params
+
+
+def dcasgd(learning_rate: float = 0.01, momentum: float = 0.0,
+           lamda: float = 0.04, weight_decay: float = 0.0) -> optax.GradientTransformation:
+    def init_fn(params):
+        return DCASGDState(
+            momentum=jax.tree.map(jnp.zeros_like, params),
+            previous_weights=jax.tree.map(jnp.asarray, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("dcasgd requires params")
+        lr = learning_rate
+
+        def one(g, m, w, pw):
+            g = g + weight_decay * w
+            m = momentum * m - lr * (g + lamda * g * g * (w - pw))
+            return m
+
+        new_mom = jax.tree.map(one, updates, state.momentum, params,
+                               state.previous_weights)
+        # the returned update is the momentum step; previous_weight tracks
+        # the post-update weight
+        new_prev = jax.tree.map(lambda w, m: w + m, params, new_mom)
+        return new_mom, DCASGDState(momentum=new_mom, previous_weights=new_prev)
+
+    return optax.GradientTransformation(init_fn, update_fn)

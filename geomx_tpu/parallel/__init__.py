@@ -1,0 +1,22 @@
+"""SPMD parallelism primitives: shard_map compat, hierarchical collectives,
+and MultiGPS-style sharded updates."""
+
+from geomx_tpu.parallel.collectives import (
+    shard_map_compat,
+    hier_psum,
+    hier_pmean,
+    psum_worker,
+    psum_dc,
+    pmean_worker,
+    pmean_dc,
+)
+
+__all__ = [
+    "shard_map_compat",
+    "hier_psum",
+    "hier_pmean",
+    "psum_worker",
+    "psum_dc",
+    "pmean_worker",
+    "pmean_dc",
+]

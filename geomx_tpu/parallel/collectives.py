@@ -1,0 +1,98 @@
+"""Hierarchical collectives over the HiPS mesh.
+
+These replace the reference's entire push/pull dataflow on the synchronous
+path (reference call stack: SURVEY.md §3.3 — worker ZPush → local server
+merge → TS_Push → global server merge → pull back down).  A hierarchical
+``psum`` over (worker, dc) axes is semantically the two-tier aggregation;
+XLA lowers each stage to the matching interconnect's collective (ICI
+all-reduce for the worker axis, DCN for the dc axis) and overlaps them with
+compute — no engine threads, no explicit messages.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions (check_vma vs check_rep kwarg)."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:
+        pass
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+    except (TypeError, AttributeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+# ---- per-leaf collectives (usable inside shard_map) ------------------------
+
+def psum_worker(tree: Any) -> Any:
+    """Intra-party aggregation — the worker → local-server merge
+    (reference: src/kvstore/kvstore_dist_server.h:1324 `== NumWorkers`)."""
+    return lax.psum(tree, WORKER_AXIS)
+
+
+def psum_dc(tree: Any) -> Any:
+    """Cross-party aggregation — the local-server → global-server merge
+    (reference: src/kvstore/kvstore_dist_server.h:1305-1318)."""
+    return lax.psum(tree, DC_AXIS)
+
+
+def pmean_worker(tree: Any) -> Any:
+    return lax.pmean(tree, WORKER_AXIS)
+
+
+def pmean_dc(tree: Any) -> Any:
+    return lax.pmean(tree, DC_AXIS)
+
+
+def hier_psum(tree: Any) -> Any:
+    """Two-tier sum: ICI stage first, then DCN stage.
+
+    Equivalent to ``psum`` over both axes but staged to mirror HiPS;
+    XLA fuses/pipelines the two all-reduces.
+    """
+    return psum_dc(psum_worker(tree))
+
+
+def hier_pmean(tree: Any) -> Any:
+    return pmean_dc(pmean_worker(tree))
+
+
+def all_gather_dc(x: jax.Array, axis: int = 0, tiled: bool = False) -> jax.Array:
+    """Gather a per-party payload across the global tier. This is the wire
+    transfer of a compressed push: each party contributes its (fixed-size)
+    compressed gradient; every party reconstructs the aggregate locally —
+    the SPMD analogue of server-side decompress-and-merge
+    (reference: kvstore_dist_server.h:1099-1114 BSCDecompress into store_)."""
+    return lax.all_gather(x, DC_AXIS, axis=axis, tiled=tiled)
+
+
+def party_index() -> jax.Array:
+    return lax.axis_index(DC_AXIS)
+
+
+def worker_index() -> jax.Array:
+    return lax.axis_index(WORKER_AXIS)
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.psum(1, axis_name)
+
+
+def global_worker_rank() -> jax.Array:
+    """Linear rank over all workers (reference: kvstore rank per worker)."""
+    return party_index() * axis_size(WORKER_AXIS) + worker_index()

@@ -1,0 +1,13 @@
+"""KVStore-compatible imperative API.
+
+A familiarity layer for users migrating from the reference's
+``mx.kv.create(...)`` surface (python/mxnet/kvstore.py:99-705): explicit
+``init/push/pull/barrier/set_optimizer/set_gradient_compression`` against
+named keys.  The functional SPMD path (``geomx_tpu.train``) is the
+performance path; this store is the compatibility/interop path and the
+home of the host-side asynchronous modes.
+"""
+
+from geomx_tpu.store.api import KVStore, create
+
+__all__ = ["KVStore", "create"]

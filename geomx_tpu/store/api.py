@@ -1,0 +1,213 @@
+"""Imperative key-value store over the HiPS mesh.
+
+Semantics parity with the reference python API (python/mxnet/kvstore.py):
+
+- ``init(key, value)``   — one-time value registration (kvstore.py:99);
+- ``push(key, value)``   — contribute gradients; values may be a per-worker
+  stack (the worker dimension of the mesh) and are aggregated
+  hierarchically (sum), like multi-device pushes through Comm::Reduce then
+  the two PS tiers;
+- ``pull(key)``          — read the current aggregated/updated value;
+- ``set_optimizer``      — server-side optimizer: subsequent pushes apply
+  the update to the stored weights instead of overwriting them
+  (kvstore.py:452 set_optimizer -> server Executor);
+- ``set_gradient_compression`` — reference kwargs format
+  {"type": "2bit"|"bsc", "threshold": x} (kvstore.py:618);
+- ``rank/num_workers/num_all_workers/is_master_worker/barrier`` — topology
+  introspection (kvstore.py:541-564).
+
+``create("local")`` = single-party in-process store (reference
+kvstore_local); ``create("dist_sync")``/``create("hips")`` = hierarchical
+store over a HiPSTopology: pushes carry leading [parties, workers] axes
+and aggregate across both tiers, compression applying to the cross-party
+hop exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from geomx_tpu.compression import get_compressor
+from geomx_tpu.compression.base import NoCompressor
+from geomx_tpu.topology import HiPSTopology
+
+
+class KVStore:
+    """Hierarchically-aggregating key-value store (single-controller)."""
+
+    def __init__(self, kind: str = "local",
+                 topology: Optional[HiPSTopology] = None):
+        self.kind = kind
+        self.topology = topology or HiPSTopology(1, 1)
+        self._store: Dict[Any, jnp.ndarray] = {}
+        self._comp = NoCompressor()
+        self._comp_state: Dict[Any, Any] = {}
+        self._tx: Optional[optax.GradientTransformation] = None
+        self._opt_state: Dict[Any, Any] = {}
+        self._updater: Optional[Callable] = None
+
+    # ---- topology introspection -------------------------------------------
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        """Workers in this party (reference: group_size)."""
+        return self.topology.workers_per_party
+
+    @property
+    def num_all_workers(self) -> int:
+        """All workers across parties (reference kvstore.py:541)."""
+        return self.topology.total_workers
+
+    @property
+    def is_master_worker(self) -> bool:
+        """Single-controller SPMD: this process plays the master worker
+        (reference: the distinguished config-driving worker, kvstore.py:554)."""
+        return True
+
+    def barrier(self):
+        """All outstanding device work completes — the SPMD analogue of the
+        reference's global barrier (kvstore.py:_barrier)."""
+        for v in self._store.values():
+            jax.block_until_ready(v)
+
+    # ---- configuration -----------------------------------------------------
+    def set_gradient_compression(self, compression_params: Dict[str, Any]):
+        ctype = compression_params.get("type", "none")
+        if ctype == "2bit":
+            spec = f"2bit,{compression_params.get('threshold', 0.5)}"
+        elif ctype == "bsc":
+            spec = f"bsc,{compression_params.get('threshold', 0.01)}"
+        elif ctype in ("none", None):
+            spec = "none"
+        elif ctype == "fp16":
+            spec = "fp16"
+        elif ctype == "mpq":
+            spec = (f"mpq,{compression_params.get('threshold', 0.01)},"
+                    f"{compression_params.get('size_lower_bound', 200_000)}")
+        else:
+            raise ValueError(f"Unknown gradient compression type {ctype}")
+        self._comp = get_compressor(spec)
+        self._comp_state = {k: self._comp.init_leaf_state(v)
+                            for k, v in self._store.items()}
+
+    def set_optimizer(self, optimizer: optax.GradientTransformation):
+        """Server-side optimizer: pushes become updates (reference pickles
+        the optimizer to the global server; here it's held directly)."""
+        self._tx = optimizer
+        for k, v in self._store.items():
+            self._opt_state[k] = self._tx.init(v)
+
+    def _set_updater(self, updater: Callable):
+        """Raw updater fn(key, grad, weight) -> weight, the reference's
+        low-level _set_updater hook."""
+        self._updater = updater
+
+    # ---- data path ---------------------------------------------------------
+    def init(self, key, value):
+        if key in self._store:
+            raise ValueError(f"duplicate init of key {key!r}")
+        v = jnp.asarray(value)
+        self._store[key] = v
+        self._comp_state[key] = self._comp.init_leaf_state(v)
+        if self._tx is not None:
+            self._opt_state[key] = self._tx.init(v)
+
+    def _aggregate(self, key, value) -> jnp.ndarray:
+        """Hierarchical sum of a pushed value.
+
+        Accepts a bare tensor, a list of per-device tensors (reference
+        multi-device push), or a stacked [parties, workers, ...] tensor
+        (SPMD global push).  Cross-party aggregation goes through the
+        configured compressor with per-key error-feedback state, mirroring
+        compression on the local->global hop.
+        """
+        ref = self._store[key]
+        if isinstance(value, (list, tuple)):
+            value = jnp.stack([jnp.asarray(v) for v in value])
+            value = jnp.sum(value, axis=0)
+            return value
+        value = jnp.asarray(value)
+        if value.shape == ref.shape:
+            return value
+        if value.shape[2:] == ref.shape and value.ndim == ref.ndim + 2:
+            # [parties, workers, ...]: worker tier sums densely,
+            # dc tier goes through the compressor
+            party_sum = jnp.sum(value, axis=1)
+            if self.topology.num_parties == 1 or isinstance(self._comp, NoCompressor):
+                return jnp.sum(party_sum, axis=0)
+            total = jnp.zeros_like(ref)
+            # per-party compress/accumulate with per-party error-feedback
+            # state (host path; the SPMD path does this as one all_gather)
+            states = self._comp_state.get(key)
+            if not isinstance(states, list):
+                states = [states] + [self._comp.init_leaf_state(ref)
+                                     for _ in range(party_sum.shape[0] - 1)]
+            for p in range(party_sum.shape[0]):
+                g, states[p] = self._comp.allreduce_leaf(
+                    party_sum[p], states[p], axis_name=None, axis_size=1)
+                total = total + g
+            self._comp_state[key] = states
+            return total
+        raise ValueError(
+            f"push shape {value.shape} incompatible with key shape {ref.shape}")
+
+    def push(self, key, value, priority: int = 0):
+        if key not in self._store:
+            raise KeyError(f"push to uninitialized key {key!r}")
+        grad = self._aggregate(key, value)
+        if self._updater is not None:
+            self._store[key] = jnp.asarray(
+                self._updater(key, grad, self._store[key]))
+        elif self._tx is not None:
+            updates, self._opt_state[key] = self._tx.update(
+                grad, self._opt_state[key], self._store[key])
+            self._store[key] = optax.apply_updates(self._store[key], updates)
+        else:
+            # pure aggregation, like the reference local tier
+            self._store[key] = grad
+
+    def pull(self, key, out=None, priority: int = 0):
+        """Read the stored value.  With ``out`` (a mutable numpy array),
+        also fills it in place, matching the reference's
+        ``kv.pull(idx, out=param.data())`` usage (examples/cnn.py:124)."""
+        if key not in self._store:
+            raise KeyError(f"pull of uninitialized key {key!r}")
+        v = self._store[key]
+        if out is not None:
+            if not isinstance(out, np.ndarray):
+                raise TypeError(
+                    "out must be a mutable numpy array (jax arrays are "
+                    "immutable); use the return value instead")
+            out[...] = np.asarray(v, dtype=out.dtype)
+            return out
+        return v
+
+    # ---- optimizer state persistence (kvstore.py:566-592) ------------------
+    def save_optimizer_states(self, fname: str):
+        with open(fname, "wb") as f:
+            pickle.dump(jax.device_get(self._opt_state), f)
+
+    def load_optimizer_states(self, fname: str):
+        with open(fname, "rb") as f:
+            self._opt_state = pickle.load(f)
+
+
+def create(name: str = "local",
+           topology: Optional[HiPSTopology] = None) -> KVStore:
+    """Factory mirroring mx.kv.create (reference kvstore.py:663 and
+    KVStore::Create, src/kvstore/kvstore.cc:41-82)."""
+    name = name.lower()
+    if name in ("local", "device"):
+        return KVStore("local", HiPSTopology(1, 1))
+    if name in ("dist_sync", "dist_async", "dist", "hips"):
+        return KVStore(name, topology or HiPSTopology.from_devices())
+    raise ValueError(f"Unknown kvstore type {name!r}")

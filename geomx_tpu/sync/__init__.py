@@ -1,0 +1,44 @@
+"""Synchronization algorithms over the two HiPS tiers.
+
+Reference suite (README.md:32-45): FSA (fully-synchronous, default),
+MixedSync (asynchronous global tier, optional DCASGD delay compensation),
+HFA (hierarchical frequency aggregation).  ESync is documented by the
+reference as "to be integrated" and has no implementation there
+(SURVEY.md "What the reference is"); we match that scope.
+
+Each algorithm is a set of pure hooks called inside the SPMD train step;
+algorithm state (milestones, stale copies, compressor residuals) is
+device-local party state threaded through the TrainState.
+"""
+
+from geomx_tpu.sync.base import SyncAlgorithm
+from geomx_tpu.sync.fsa import FSA
+from geomx_tpu.sync.hfa import HFA
+from geomx_tpu.sync.mixed import MixedSync
+from geomx_tpu.sync.dgt import DGTCompressor
+
+__all__ = ["SyncAlgorithm", "FSA", "HFA", "MixedSync", "DGTCompressor",
+           "get_sync_algorithm"]
+
+
+def get_sync_algorithm(cfg, compressor=None):
+    """Build the sync algorithm named by ``cfg.sync_mode`` from a GeoConfig."""
+    from geomx_tpu.compression import get_compressor
+    comp = compressor if compressor is not None else get_compressor(cfg.compression)
+    if cfg.enable_dgt:
+        comp = DGTCompressor(inner=comp, block_elems=max(1, cfg.dgt_block_size // 4),
+                             k=cfg.dgt_k, alpha=cfg.dgt_contri_alpha,
+                             channels=cfg.udp_channel_num)
+    mode = cfg.sync_mode.lower()
+    if mode in ("fsa", "dist_sync", "sync"):
+        return FSA(dc_compressor=comp)
+    if mode in ("mixed", "dist_async", "async"):
+        # DCASGD compensation is opt-in (reference: --dcasgd flag selects it;
+        # plain --mixed-sync runs the uncompensated optimizer)
+        lam = cfg.dcasgd_lambda if getattr(cfg, "dcasgd", False) else 0.0
+        return MixedSync(dc_compressor=comp,
+                         pull_interval=cfg.mixed_pull_interval,
+                         dcasgd_lambda=lam)
+    if mode == "hfa":
+        return HFA(k1=cfg.hfa_k1, k2=cfg.hfa_k2, dc_compressor=comp)
+    raise ValueError(f"Unknown sync mode: {cfg.sync_mode!r}")

@@ -1,0 +1,54 @@
+"""SyncAlgorithm protocol.
+
+The reference expresses synchronization imperatively: workers push/pull
+against servers, servers count arrivals and gate on barriers
+(kvstore_dist_server.h:1216-1370).  Here a sync algorithm is three pure
+hooks around the optimizer step, executed per-device inside shard_map:
+
+- ``forward_params``  — which parameters the worker computes gradients at
+  (MixedSync workers hold *stale* copies of the global weights);
+- ``sync_grads``      — gradient-space communication (FSA's hierarchical
+  aggregation; identity for HFA, whose workers update locally);
+- ``sync_params``     — parameter-space communication after the optimizer
+  (HFA's K1/K2 averaging with milestones; stale-copy refresh for MixedSync).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Tuple
+
+import jax
+
+
+class SyncAlgorithm(abc.ABC):
+    name: str = "base"
+
+    # mesh axis sizes; set by bind_topology before tracing (they gate static
+    # Python branches like axis_size == 1 short-circuits)
+    num_parties: int = 1
+    workers_per_party: int = 1
+
+    def bind_topology(self, topology) -> "SyncAlgorithm":
+        self.num_parties = topology.num_parties
+        self.workers_per_party = topology.workers_per_party
+        return self
+
+    def init_state(self, params: Any) -> Any:
+        """Algorithm state from example (unsharded, single-replica) params."""
+        return {}
+
+    def forward_params(self, params: Any, state: Any) -> Any:
+        return params
+
+    def sync_grads(self, grads: Any, params: Any, state: Any,
+                   step: jax.Array) -> Tuple[Any, Any]:
+        return grads, state
+
+    def sync_params(self, params: Any, state: Any,
+                    step: jax.Array) -> Tuple[Any, Any]:
+        return params, state
+
+    def sync_model_state(self, model_state: Any, step: jax.Array) -> Any:
+        """Hook for non-trainable model state (e.g. BatchNorm statistics)."""
+        return model_state

@@ -1,0 +1,67 @@
+"""FSA — Fully Synchronous Algorithm (the reference's dist_sync default).
+
+Reference dataflow (SURVEY.md §3.3): every step, workers push gradients to
+their local PS; the local tier is pure aggregation (ApplyUpdates with no
+updater, kvstore_dist_server.h:502-523); local servers push the merged
+gradient to the global tier, which runs the optimizer once all parties
+arrive (kvstore_dist_server.h:1305-1318); fresh weights flow back down.
+
+TPU-native: one hierarchical compressed all-reduce per step —
+
+    g_party  = psum(g, "worker") / workers_per_party      (ICI tier)
+    g_global = dc_compressor.allreduce(g_party, "dc") / P (DCN tier)
+
+followed by an optimizer step applied identically on every device, which
+keeps parameters replicated without any explicit pull.  The dc-tier
+compressor slot is where Bi-Sparse / FP16 / MPQ / 2-bit plug in, exactly
+the hop they compress in the reference (local server -> global server).
+An optional worker-tier compressor covers the reference's intra-DC fp16
+mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+from jax import lax
+
+from geomx_tpu.compression.base import Compressor, NoCompressor
+from geomx_tpu.sync.base import SyncAlgorithm
+from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
+
+
+class FSA(SyncAlgorithm):
+    name = "fsa"
+
+    def __init__(self, dc_compressor: Optional[Compressor] = None,
+                 worker_compressor: Optional[Compressor] = None):
+        self.dc_compressor = dc_compressor or NoCompressor()
+        self.worker_compressor = worker_compressor or NoCompressor()
+
+    def init_state(self, params: Any) -> Any:
+        return {
+            "dc_comp": self.dc_compressor.init_state(params),
+            "worker_comp": self.worker_compressor.init_state(params),
+        }
+
+    def sync_grads(self, grads: Any, params: Any, state: Any,
+                   step: jax.Array) -> Tuple[Any, Any]:
+        nw = self.workers_per_party
+        np_ = self.num_parties
+        # intra-party tier (ICI): mean over workers
+        g, wstate = self.worker_compressor.allreduce(
+            grads, state["worker_comp"], WORKER_AXIS, nw)
+        g = jax.tree.map(lambda x: x / nw, g)
+        # cross-party tier (DCN): compressed mean over parties
+        g, dstate = self.dc_compressor.allreduce(g, state["dc_comp"], DC_AXIS, np_)
+        g = jax.tree.map(lambda x: x / np_, g)
+        return g, {"dc_comp": dstate, "worker_comp": wstate}
+
+    def sync_model_state(self, model_state: Any, step: jax.Array) -> Any:
+        # keep non-trainable stats (BatchNorm) consistent across replicas
+        if self.workers_per_party > 1:
+            model_state = lax.pmean(model_state, WORKER_AXIS)
+        if self.num_parties > 1:
+            model_state = lax.pmean(model_state, DC_AXIS)
+        return model_state

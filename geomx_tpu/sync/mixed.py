@@ -1,0 +1,92 @@
+"""MixedSync — asynchronous global tier, with optional DCASGD compensation.
+
+Reference semantics (README.md:36-40): the intra-party tier stays
+synchronous, but local servers push to the global tier without a barrier
+(DataHandleAsyncDefault, kvstore_dist_server.h:1532-1625); the global
+optimizer applies each party's gradient as it arrives, so a party's
+gradient is computed at weights that are stale by the other parties'
+in-flight updates.  DCASGD (python/mxnet/optimizer/optimizer.py:872-925)
+compensates: for gradient g pushed from stale weights w_stale applied at
+current weights w,
+
+    g_compensated = g + lambda * g * g * (w - w_stale).
+
+TPU-native emulation inside one SPMD program: true weights evolve
+deterministically on every device; each party holds a *stale copy* it
+computes gradients at, refreshed every ``pull_interval`` steps (the
+asynchronous pull).  Each step the global update applies the sum of all
+parties' delay-compensated gradients — the batched equivalent of the
+reference's arrival-ordered sequence of async applies.  ``pull_interval``
+plays the role of the reference's effective staleness (its async tier has
+staleness ~1 round).  For exact multi-process asynchrony across hosts, the
+host-side parameter service in ``geomx_tpu.store`` is the escape hatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from geomx_tpu.compression.base import Compressor, NoCompressor
+from geomx_tpu.sync.base import SyncAlgorithm
+from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
+
+
+class MixedSync(SyncAlgorithm):
+    name = "mixed"
+
+    def __init__(self, dc_compressor: Optional[Compressor] = None,
+                 pull_interval: int = 1, dcasgd_lambda: float = 0.0):
+        if pull_interval < 1:
+            raise ValueError("pull_interval must be >= 1")
+        self.dc_compressor = dc_compressor or NoCompressor()
+        self.pull_interval = int(pull_interval)
+        self.dcasgd_lambda = float(dcasgd_lambda)
+
+    def init_state(self, params: Any) -> Any:
+        return {
+            "stale": jax.tree.map(jnp.asarray, params),
+            "dc_comp": self.dc_compressor.init_state(params),
+        }
+
+    def forward_params(self, params: Any, state: Any) -> Any:
+        # parties train at their stale pull of the global weights
+        return state["stale"]
+
+    def sync_grads(self, grads: Any, params: Any, state: Any,
+                   step: jax.Array) -> Tuple[Any, Any]:
+        nw = self.workers_per_party
+        # intra-party tier stays synchronous (dist_async still merges the
+        # party's workers at the local server before the global push)
+        if nw > 1:
+            grads = jax.tree.map(lambda g: lax.pmean(g, WORKER_AXIS), grads)
+        if self.dcasgd_lambda > 0.0:
+            lam = self.dcasgd_lambda
+            grads = jax.tree.map(
+                lambda g, w, ws: g + lam * g * g * (w - ws),
+                grads, params, state["stale"])
+        np_ = self.num_parties
+        grads, dstate = self.dc_compressor.allreduce(
+            grads, state["dc_comp"], DC_AXIS, np_)
+        grads = jax.tree.map(lambda g: g / np_, grads)
+        state = dict(state, dc_comp=dstate)
+        return grads, state
+
+    def sync_params(self, params: Any, state: Any,
+                    step: jax.Array) -> Tuple[Any, Any]:
+        # the asynchronous pull: refresh the stale copy every pull_interval
+        do_pull = ((step + 1) % self.pull_interval) == 0
+        stale = lax.cond(do_pull, lambda _: params, lambda s: s, state["stale"])
+        return params, dict(state, stale=stale)
+
+    def sync_model_state(self, model_state: Any, step: jax.Array) -> Any:
+        if not jax.tree.leaves(model_state):
+            return model_state
+        if self.workers_per_party > 1:
+            model_state = lax.pmean(model_state, WORKER_AXIS)
+        if self.num_parties > 1:
+            model_state = lax.pmean(model_state, DC_AXIS)
+        return model_state

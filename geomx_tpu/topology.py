@@ -1,0 +1,101 @@
+"""HiPS topology → TPU device mesh.
+
+The reference builds its hierarchy out of processes: per-party PS clusters
+joined by a global PS tier, with dual node identities on the local servers
+(reference: 3rdparty/ps-lite/include/ps/ps.h:52-58, van.h:100).  The
+TPU-native expression of the same two tiers is a 2-D
+``jax.sharding.Mesh`` with named axes:
+
+- ``"dc"``     — the cross-data-center (global/WAN) tier.  On a multi-pod
+  deployment this axis is laid out over DCN; collectives over it are the
+  equivalent of local-server → global-server push/pull.
+- ``"worker"`` — the intra-party tier.  Laid out over ICI; collectives over
+  it replace worker → local-server push/pull.
+
+All gradient/parameter synchronization in this framework is an SPMD
+collective over one or both axes inside a single jitted train step — there
+is no parameter-server process, no wire format, and no explicit message
+loop on the synchronous paths (the async MixedSync global tier keeps a
+host-side service; see ``geomx_tpu.store``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Axis names for the two HiPS tiers.
+DC_AXIS = "dc"          # cross-party / global tier (DCN)
+WORKER_AXIS = "worker"  # intra-party / local tier (ICI)
+
+# Both tiers, innermost-varying last: device order keeps a party's workers
+# adjacent so the worker axis rides ICI.
+REPLICA_AXES = (DC_AXIS, WORKER_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class HiPSTopology:
+    """A two-tier hierarchical data-parallel topology.
+
+    ``num_parties`` plays the role of the reference's number of global
+    workers (= local-server count), ``workers_per_party`` the number of
+    training workers inside each party
+    (reference: scripts/cpu/run_vanilla_hips.sh 2 parties x 2 workers).
+    """
+
+    num_parties: int = 1
+    workers_per_party: int = 1
+
+    def __post_init__(self):
+        if self.num_parties < 1 or self.workers_per_party < 1:
+            raise ValueError("topology sizes must be >= 1")
+
+    @property
+    def total_workers(self) -> int:
+        """All training workers across parties (reference: ``num_all_workers``,
+        python/mxnet/kvstore.py:541)."""
+        return self.num_parties * self.workers_per_party
+
+    @classmethod
+    def from_devices(cls, num_parties: Optional[int] = None,
+                     devices: Optional[Sequence[jax.Device]] = None) -> "HiPSTopology":
+        """Infer a topology covering all (or the given) devices.
+
+        With ``num_parties`` unset, picks the largest power-of-two split with
+        at least 2 parties when possible (e.g. 8 devices -> 2 parties x 4).
+        """
+        n = len(devices) if devices is not None else len(jax.devices())
+        if num_parties is None:
+            num_parties = 2 if n % 2 == 0 and n >= 2 else 1
+        if n % num_parties != 0:
+            raise ValueError(f"{n} devices not divisible by {num_parties} parties")
+        return cls(num_parties=num_parties, workers_per_party=n // num_parties)
+
+    def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        """Build the 2-D mesh. Requires num_parties*workers_per_party devices."""
+        if devices is None:
+            devices = jax.devices()
+        need = self.num_parties * self.workers_per_party
+        if len(devices) < need:
+            raise ValueError(
+                f"topology needs {need} devices, only {len(devices)} available")
+        grid = np.asarray(devices[:need]).reshape(
+            self.num_parties, self.workers_per_party)
+        return Mesh(grid, axis_names=REPLICA_AXES)
+
+    # ---- sharding helpers -------------------------------------------------
+
+    def replica_sharding(self, mesh: Mesh) -> NamedSharding:
+        """Sharding for per-replica state: leading [num_parties, workers] axes."""
+        return NamedSharding(mesh, P(DC_AXIS, WORKER_AXIS))
+
+    def replicated_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, P())
+
+    def batch_sharding(self, mesh: Mesh) -> NamedSharding:
+        """Sharding for global batches shaped [parties, workers, local_b, ...]."""
+        return NamedSharding(mesh, P(DC_AXIS, WORKER_AXIS))

@@ -1,0 +1,124 @@
+"""The jitted SPMD training step.
+
+One ``jax.jit(shard_map(...))`` program per configuration replaces the
+reference's entire per-step dataflow — imperative forward/backward through
+the dependency engine, engine-async kvstore push, PS-side merge at two
+tiers, optimizer at the global server, and the pull back down
+(SURVEY.md §3.2-3.4).  XLA sees compute and both collective tiers in one
+graph and overlaps them (the latency-hiding the reference needed P3 and
+engine threads for comes from the scheduler here).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from geomx_tpu.parallel.collectives import shard_map_compat
+from geomx_tpu.sync.base import SyncAlgorithm
+from geomx_tpu.topology import DC_AXIS, WORKER_AXIS, HiPSTopology
+from geomx_tpu.train.state import TrainState, state_specs
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_loss_fn(apply_fn: Callable, mutable_keys=("batch_stats",)):
+    """Standard classification loss closure over a flax apply_fn.
+
+    Images arrive uint8 NHWC; normalization to [0,1] happens on-device so
+    the host->device transfer stays 1 byte/pixel.
+    """
+
+    def loss_fn(params, model_state, x, y):
+        x = x.astype(jnp.float32) / 255.0
+        variables = {"params": params, **model_state}
+        mut = [k for k in mutable_keys if k in model_state]
+        if mut:
+            logits, new_model_state = apply_fn(variables, x, train=True,
+                                               mutable=mut)
+        else:
+            logits = apply_fn(variables, x, train=True)
+            new_model_state = model_state
+        loss = cross_entropy_loss(logits, y)
+        return loss, (new_model_state, logits)
+
+    return loss_fn
+
+
+def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
+                     sync: SyncAlgorithm, topology: HiPSTopology, mesh: Mesh,
+                     donate: bool = True):
+    """Build `train_step(state, x, y) -> (state, metrics)`.
+
+    - state leaves carry [num_parties, workers_per_party] replica axes;
+    - x, y are [num_parties, workers_per_party, local_batch, ...];
+    - metrics are global means (replicated scalars).
+    """
+    sync.bind_topology(topology)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def _device_step(state: TrainState, x, y):
+        squeeze = lambda t: jax.tree.map(lambda a: a[0, 0], t)
+        expand = lambda t: jax.tree.map(lambda a: a[None, None], t)
+        params = squeeze(state.params)
+        opt_state = squeeze(state.opt_state)
+        model_state = squeeze(state.model_state)
+        sync_state = squeeze(state.sync_state)
+        step = state.step
+        xb, yb = x[0, 0], y[0, 0]
+
+        fwd_params = sync.forward_params(params, sync_state)
+        (loss, (model_state, logits)), grads = grad_fn(
+            fwd_params, model_state, xb, yb)
+
+        grads, sync_state = sync.sync_grads(grads, params, sync_state, step)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params, sync_state = sync.sync_params(params, sync_state, step)
+        model_state = sync.sync_model_state(model_state, step)
+
+        acc = jnp.mean(jnp.argmax(logits, -1) == yb)
+        metrics = {"loss": loss, "accuracy": acc}
+        # global mean over every worker for reporting
+        metrics = jax.lax.pmean(jax.lax.pmean(metrics, WORKER_AXIS), DC_AXIS)
+
+        new_state = TrainState(
+            step=step + 1,
+            params=expand(params),
+            opt_state=expand(opt_state),
+            model_state=expand(model_state),
+            sync_state=expand(sync_state),
+        )
+        return new_state, metrics
+
+    specs = state_specs()
+    batch_spec = P(DC_AXIS, WORKER_AXIS)
+    mapped = shard_map_compat(
+        _device_step, mesh,
+        in_specs=(specs, batch_spec, batch_spec),
+        out_specs=(specs, P()),
+    )
+    if donate:
+        return jax.jit(mapped, donate_argnums=(0,))
+    return jax.jit(mapped)
+
+
+def build_eval_step(apply_fn: Callable):
+    """Single-program eval on unreplicated params (any one device)."""
+
+    @jax.jit
+    def eval_step(params, model_state, x, y):
+        x = x.astype(jnp.float32) / 255.0
+        variables = {"params": params, **model_state}
+        logits = apply_fn(variables, x, train=False)
+        pred = jnp.argmax(logits, -1)
+        return jnp.sum(pred == y), jnp.asarray(y.shape[0], jnp.int32)
+
+    return eval_step

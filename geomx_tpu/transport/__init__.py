@@ -1,0 +1,14 @@
+"""Transport-layer scheduling equivalents.
+
+The reference implements three transport accelerators inside its forked
+ps-lite (P3 priority propagation, DGT multi-channel QoS, TSEngine adaptive
+overlays).  On TPU the synchronous data path needs none of them — XLA's
+latency-hiding scheduler overlaps collectives with compute — but their
+*scheduling logic* remains valuable for the host-side asynchronous modes
+and is implemented here as standalone, fully-tested components.
+"""
+
+from geomx_tpu.transport.p3 import P3Slicer, PrioritySendQueue
+from geomx_tpu.transport.tsengine import TSEngineScheduler
+
+__all__ = ["P3Slicer", "PrioritySendQueue", "TSEngineScheduler"]

@@ -1,0 +1,8 @@
+"""Observability & ops utilities: metrics reporting, checkpointing,
+profiling, failure detection."""
+
+from geomx_tpu.utils.metrics import Measure
+from geomx_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
+from geomx_tpu.utils.heartbeat import HeartbeatMonitor
+
+__all__ = ["Measure", "save_checkpoint", "load_checkpoint", "HeartbeatMonitor"]

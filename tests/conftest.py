@@ -1,0 +1,42 @@
+"""Test harness: 8 virtual CPU devices so the 2-tier HiPS mesh (2 parties x
+4 workers, or 4 x 2) runs multi-"chip" on one host — the same trick as the
+reference's pseudo-distributed localhost scripts
+(scripts/cpu/run_vanilla_hips.sh runs 12 processes on 127.0.0.1)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU-tunnel plugin overrides JAX_PLATFORMS at import time; force
+# the virtual CPU mesh explicitly
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from geomx_tpu.topology import HiPSTopology  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def topo2x4():
+    return HiPSTopology(num_parties=2, workers_per_party=4)
+
+
+@pytest.fixture(scope="session")
+def topo4x2():
+    return HiPSTopology(num_parties=4, workers_per_party=2)
+
+
+@pytest.fixture(scope="session")
+def mesh2x4(topo2x4):
+    return topo2x4.build_mesh()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,138 @@
+"""KVStore-compat API tests (reference python/mxnet/kvstore.py semantics)."""
+
+import numpy as np
+import optax
+import pytest
+
+from geomx_tpu.store import create
+from geomx_tpu.topology import HiPSTopology
+
+
+def test_local_init_push_pull():
+    kv = create("local")
+    kv.init(0, np.ones((4,), np.float32))
+    out = np.asarray(kv.pull(0))
+    np.testing.assert_allclose(out, 1.0)
+    # push without optimizer = aggregation (local tier semantics)
+    kv.push(0, np.full((4,), 2.0, np.float32))
+    np.testing.assert_allclose(np.asarray(kv.pull(0)), 2.0)
+
+
+def test_multi_device_push_sums():
+    kv = create("local")
+    kv.init("w", np.zeros((3,), np.float32))
+    kv.push("w", [np.ones((3,), np.float32), np.full((3,), 2.0, np.float32)])
+    np.testing.assert_allclose(np.asarray(kv.pull("w")), 3.0)
+
+
+def test_push_uninitialized_raises():
+    kv = create("local")
+    with pytest.raises(KeyError):
+        kv.push("nope", np.zeros(2))
+    with pytest.raises(KeyError):
+        kv.pull("nope")
+    kv.init("a", np.zeros(2))
+    with pytest.raises(ValueError):
+        kv.init("a", np.zeros(2))
+
+
+def test_hier_push_aggregates_two_tiers():
+    topo = HiPSTopology(num_parties=2, workers_per_party=2)
+    kv = create("hips", topology=topo)
+    assert kv.num_all_workers == 4
+    assert kv.num_workers == 2
+    kv.init(0, np.zeros((5,), np.float32))
+    stacked = np.ones((2, 2, 5), np.float32)  # [parties, workers, dim]
+    kv.push(0, stacked)
+    np.testing.assert_allclose(np.asarray(kv.pull(0)), 4.0)
+
+
+def test_set_optimizer_turns_push_into_update():
+    kv = create("local")
+    kv.init("w", np.zeros((4,), np.float32))
+    kv.set_optimizer(optax.sgd(0.1))
+    kv.push("w", np.ones((4,), np.float32))
+    np.testing.assert_allclose(np.asarray(kv.pull("w")), -0.1, rtol=1e-6)
+    kv.push("w", np.ones((4,), np.float32))
+    np.testing.assert_allclose(np.asarray(kv.pull("w")), -0.2, rtol=1e-6)
+
+
+def test_set_gradient_compression_reference_kwargs():
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    kv = create("dist_sync", topology=topo)
+    kv.init(0, np.zeros((4096,), np.float32))
+    kv.set_gradient_compression({"type": "bsc", "threshold": 0.01})
+    g = np.zeros((2, 1, 4096), np.float32)
+    g[0, 0, 7] = 10.0
+    g[1, 0, 13] = -8.0
+    kv.push(0, g)
+    out = np.asarray(kv.pull(0))
+    assert out[7] == pytest.approx(10.0)
+    assert out[13] == pytest.approx(-8.0)
+    # sparsified: only top-ratio coordinates survive
+    assert (out != 0).sum() <= 2 * int(np.ceil(4096 * 0.01))
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({"type": "wat"})
+
+
+def test_updater_hook():
+    kv = create("local")
+    kv.init("w", np.ones((2,), np.float32))
+    kv._set_updater(lambda key, grad, weight: weight - 0.5 * grad)
+    kv.push("w", np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(kv.pull("w")), 0.5)
+
+
+def test_optimizer_state_save_load(tmp_path):
+    kv = create("local")
+    kv.init("w", np.zeros((4,), np.float32))
+    kv.set_optimizer(optax.adam(0.1))
+    kv.push("w", np.ones((4,), np.float32))
+    f = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(f)
+    kv2 = create("local")
+    kv2.init("w", np.zeros((4,), np.float32))
+    kv2.set_optimizer(optax.adam(0.1))
+    kv2.load_optimizer_states(f)
+    # same optimizer state + same grad -> same Adam update delta
+    w_kv, w_kv2 = np.asarray(kv.pull("w")), np.asarray(kv2.pull("w"))
+    kv.push("w", np.ones((4,), np.float32))
+    kv2.push("w", np.ones((4,), np.float32))
+    d1 = np.asarray(kv.pull("w")) - w_kv
+    d2 = np.asarray(kv2.pull("w")) - w_kv2
+    np.testing.assert_allclose(d1, d2, rtol=1e-6)
+
+
+def test_multigps_partition_parity():
+    from geomx_tpu.parallel.multigps import partition, HASH_PRIME
+    sizes = [100, 2_000_000, 500]
+    placements = partition(sizes, num_servers=4, bigarray_bound=1_000_000)
+    # small tensors: hashed whole to (key*9973) % num_servers
+    assert placements[0].split is False
+    assert placements[0].server == (0 * HASH_PRIME) % 4
+    assert placements[2].server == (2 * HASH_PRIME) % 4
+    # big tensor: split across all servers
+    assert placements[1].split is True
+    b = placements[1].shard_bounds
+    assert len(b) == 5 and b[0] == 0 and b[-1] == 2_000_000
+    assert all(b[i] < b[i + 1] for i in range(4))
+
+
+def test_pull_fills_out_array():
+    kv = create("local")
+    kv.init("w", np.arange(4, dtype=np.float32))
+    buf = np.zeros((4,), np.float32)
+    ret = kv.pull("w", out=buf)
+    np.testing.assert_allclose(buf, np.arange(4))
+    assert ret is buf
+    with pytest.raises(TypeError):
+        kv.pull("w", out=[0, 0, 0, 0])
+
+
+def test_mixed_sync_dcasgd_opt_in():
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.sync import get_sync_algorithm
+    plain = get_sync_algorithm(GeoConfig(sync_mode="dist_async"))
+    assert plain.dcasgd_lambda == 0.0
+    comp = get_sync_algorithm(GeoConfig(sync_mode="dist_async", dcasgd=True))
+    assert comp.dcasgd_lambda == pytest.approx(0.04)

@@ -1,0 +1,40 @@
+import jax
+import numpy as np
+import pytest
+
+from geomx_tpu.topology import HiPSTopology, DC_AXIS, WORKER_AXIS
+
+
+def test_mesh_axes(topo2x4):
+    mesh = topo2x4.build_mesh()
+    assert mesh.axis_names == (DC_AXIS, WORKER_AXIS)
+    assert mesh.devices.shape == (2, 4)
+    assert topo2x4.total_workers == 8
+
+
+def test_from_devices_default_split():
+    topo = HiPSTopology.from_devices()
+    assert topo.num_parties * topo.workers_per_party == len(jax.devices())
+    assert topo.num_parties == 2
+
+
+def test_bad_topology():
+    with pytest.raises(ValueError):
+        HiPSTopology(num_parties=0, workers_per_party=1)
+    with pytest.raises(ValueError):
+        HiPSTopology(num_parties=3, workers_per_party=9).build_mesh()
+
+
+def test_config_env_roundtrip(monkeypatch):
+    from geomx_tpu.config import GeoConfig
+    monkeypatch.setenv("GEOMX_NUM_PARTIES", "4")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_SIZE_LOWER_BOUND", "12345")
+    monkeypatch.setenv("ENABLE_DGT", "2")
+    monkeypatch.setenv("DMLC_K", "0.8")
+    cfg = GeoConfig.from_env()
+    assert cfg.num_parties == 4
+    assert cfg.workers_per_party == 2
+    assert cfg.size_lower_bound == 12345
+    assert cfg.enable_dgt == 2
+    assert cfg.dgt_k == 0.8
