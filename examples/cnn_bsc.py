@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Bi-Sparse gradient-sparsified training (reference examples/cnn_bsc.py).
+
+The -bcr ratio defaults to 0.01 as in the reference; the cross-party push
+and pull both move only ~ratio of each large tensor (2*k floats/party)."""
+
+import sys
+
+from cnn_common import run
+
+
+if __name__ == "__main__":
+    run(extra_args=[("-bcr", "--bsc-compression-ratio", float, 0.01)],
+        config_fn=lambda a: {"compression": f"bsc,{a.bsc_compression_ratio}"})

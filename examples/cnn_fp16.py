@@ -1,0 +1,9 @@
+#!/usr/bin/env python
+"""FP16 low-precision transmission (reference examples/cnn_fp16.py):
+fp32 compute, 16-bit cross-tier transfers."""
+
+from cnn_common import run
+
+
+if __name__ == "__main__":
+    run(config_fn=lambda a: {"compression": "fp16"})

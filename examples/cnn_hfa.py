@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""Hierarchical Frequency Aggregation (reference examples/cnn_hfa.py):
+workers update locally, parameter-average within the party every K1 steps
+and across parties every K1*K2 steps (K1/K2 from GEOMX_HFA_K1/K2 or
+DMLC_K1/K2; the reference demo uses K1=20, K2=10)."""
+
+from cnn_common import run
+
+
+if __name__ == "__main__":
+    run(sync_default="hfa",
+        extra_args=[("-ee", "--eval-every", int, 200)],
+        config_fn=lambda a: {})

@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Mixed-Precision Quantization (reference examples/cnn_mpq.py): tiny
+tensors travel fp16, large tensors Bi-Sparse; the split bound comes from
+GEOMX_SIZE_LOWER_BOUND / MXNET_KVSTORE_SIZE_LOWER_BOUND (default 200000)."""
+
+from cnn_common import run
+
+
+if __name__ == "__main__":
+    run(extra_args=[("-bcr", "--bsc-compression-ratio", float, 0.01)],
+        config_fn=lambda a: {
+            "compression": f"mpq,{a.bsc_compression_ratio}"})

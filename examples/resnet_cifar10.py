@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Flagship benchmark workload: ResNet on CIFAR10 over a 2-tier HiPS mesh
+(BASELINE.md north star).  Any sync mode / compression via env vars:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  GEOMX_NUM_PARTIES=2 GEOMX_WORKERS_PER_PARTY=4 \
+  GEOMX_COMPRESSION=bsc,0.01 python examples/resnet_cifar10.py -c -ep 1
+"""
+
+from cnn_common import run
+
+
+if __name__ == "__main__":
+    import sys
+    sys.argv += ["--model", "resnet20", "--dataset", "cifar10"]
+    run(extra_args=[("-ee", "--eval-every", int, 50)])
