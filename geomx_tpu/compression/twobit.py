@@ -57,10 +57,17 @@ def _codes_to_values(codes: jax.Array, threshold: float) -> jax.Array:
 class TwoBitCompressor(Compressor):
     name = "2bit"
 
-    def __init__(self, threshold: float = 0.5):
+    def __init__(self, threshold: float = 0.5, use_pallas: bool = False,
+                 pallas_interpret: bool = False):
+        """``use_pallas`` switches quantize/dequantize to the fused Pallas
+        kernels in geomx_tpu.ops (one HBM pass; TPU-native path).  The wire
+        format differs between the paths but both are self-inverse, and the
+        dequantized values are identical."""
         if threshold <= 0:
             raise ValueError("threshold must be greater than 0")  # gc.cc:50
         self.threshold = float(threshold)
+        self.use_pallas = use_pallas
+        self.pallas_interpret = pallas_interpret
 
     def init_leaf_state(self, leaf: jax.Array) -> Any:
         # error-feedback residual, same shape as the gradient
@@ -80,6 +87,8 @@ class TwoBitCompressor(Compressor):
 
     def allreduce_leaf(self, g: jax.Array, residual: Any, axis_name: str,
                        axis_size: int) -> Tuple[jax.Array, Any]:
+        if self.use_pallas:
+            return self._allreduce_pallas(g, residual, axis_name, axis_size)
         shape, dtype = g.shape, g.dtype
         gf = g.reshape(-1).astype(jnp.float32)
         words, new_res = self.quantize(gf, residual.reshape(-1))
@@ -94,6 +103,24 @@ class TwoBitCompressor(Compressor):
             signs = jnp.where(codes == 1, 1, jnp.where(codes == 2, -1, 0))
             total_signs = jnp.sum(signs, axis=0).reshape(-1)[:gf.shape[0]]
             out = total_signs.astype(jnp.float32) * self.threshold
+        return out.reshape(shape).astype(dtype), new_res.reshape(shape)
+
+    def _allreduce_pallas(self, g: jax.Array, residual: Any, axis_name: str,
+                          axis_size: int) -> Tuple[jax.Array, Any]:
+        from geomx_tpu.ops import dequantize_2bit, quantize_2bit
+
+        shape, dtype, n = g.shape, g.dtype, g.size
+        interp = self.pallas_interpret
+        packed, new_res = quantize_2bit(g.reshape(-1), residual.reshape(-1),
+                                        self.threshold, interpret=interp)
+        if axis_size == 1:
+            out = dequantize_2bit(packed, n, self.threshold, interpret=interp)
+        else:
+            gathered = lax.all_gather(packed, axis_name)  # [axis, words]
+            parts = [dequantize_2bit(gathered[i], n, self.threshold,
+                                     interpret=interp)
+                     for i in range(axis_size)]
+            out = sum(parts[1:], parts[0])
         return out.reshape(shape).astype(dtype), new_res.reshape(shape)
 
     def wire_bytes_leaf(self, leaf: jax.Array) -> int:

@@ -1,0 +1,19 @@
+"""Custom TPU kernels (Pallas) for the hot compression ops.
+
+The reference implements its custom math as CPU loops + CUDA kernels
+(gradient_compression-inl.h, gradient_compression.cu); here the
+numerically custom pieces are Pallas TPU kernels, fused so a gradient
+makes one HBM round trip:
+
+- ``quantize_2bit``: residual += grad; threshold compare; pack 16 2-bit
+  codes per int32 word; residual -= sent — one pass.
+- ``dequantize_2bit``: unpack + scale.
+
+Kernels run natively on TPU and in Pallas interpret mode elsewhere
+(tests exercise them on CPU via interpret mode).
+"""
+
+from geomx_tpu.ops.twobit_pallas import (quantize_2bit, dequantize_2bit,
+                                         pallas_supported)
+
+__all__ = ["quantize_2bit", "dequantize_2bit", "pallas_supported"]

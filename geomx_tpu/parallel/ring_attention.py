@@ -1,0 +1,115 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has no attention workloads (its scale axis is geographic —
+SURVEY.md §5 "long-context: absent"); this framework treats long-context
+as first-class alongside the geo tiers.  Ring attention shards the
+sequence across a mesh axis: each device holds one Q/K/V block, K/V blocks
+rotate around the ring via ``ppermute`` while every device accumulates its
+Q block's attention with a numerically-stable streaming softmax
+(flash-attention style running max / normalizer).  Peak memory per device
+is O(L/n · L/n) per step instead of O(L²), and each hop's transfer
+overlaps the current block's compute — the same overlap discipline the
+geo tiers use.
+
+Composes with HiPS: a 3-D mesh ("dc", "worker", "sp") runs hierarchical
+data parallelism across the first two axes and ring attention along the
+third.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block(q, k, v, m, l, o, scale, mask):
+    """One flash-attention accumulation step.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; m, l: [B, H, Lq]; o like q.
+    mask: [Lq, Lk] boolean or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows: exp(-inf - -inf) -> use safe m
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + \
+        jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False) -> jax.Array:
+    """Sequence-parallel attention; call inside shard_map.
+
+    q, k, v: local blocks [B, L_local, H, D] (sequence sharded over
+    ``axis_name``).  Returns the local output block [B, L_local, H, D].
+    With ``causal=True`` positions attend only to earlier global positions
+    (block-wise masking; within-block mask on the diagonal block).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+
+    m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+
+    qf = q.astype(jnp.float32)
+    tri = jnp.tril(jnp.ones((Lq, Lq), bool))
+
+    def body(step, carry):
+        m, l, o, kk, vv = carry
+        # kv block currently held came from device (idx - step) mod n
+        src = (idx - step) % n
+        if causal:
+            # diagonal block: lower-triangular; earlier blocks: full;
+            # later blocks: empty
+            def masked(m_, l_, o_):
+                return _block(qf, kk, vv, m_, l_, o_, scale, tri)
+
+            def full(m_, l_, o_):
+                return _block(qf, kk, vv, m_, l_, o_, scale, None)
+
+            def skip(m_, l_, o_):
+                return m_, l_, o_
+
+            m, l, o = lax.cond(
+                src == idx, masked,
+                lambda m_, l_, o_: lax.cond(src < idx, full, skip, m_, l_, o_),
+                m, l, o)
+        else:
+            m, l, o = _block(qf, kk, vv, m, l, o, scale, None)
+        # rotate K/V around the ring (skip after the final block)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return m, l, o, kk, vv
+
+    m, l, o, _, _ = lax.fori_loop(
+        0, n, body, (m0, l0, o0, k.astype(jnp.float32), v.astype(jnp.float32)))
+    l = jnp.maximum(l, 1e-20)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention_reference(q, k, v, causal: bool = False):
+    """Dense O(L^2) attention for correctness tests."""
+    B, L, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    if causal:
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,66 @@
+"""Ring attention correctness vs dense attention on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from geomx_tpu.parallel.collectives import shard_map_compat
+from geomx_tpu.parallel.ring_attention import (full_attention_reference,
+                                               ring_attention)
+
+
+def _run_ring(q, k, v, n_shards, causal):
+    devs = np.asarray(jax.devices()[:n_shards])
+    mesh = Mesh(devs, axis_names=("sp",))
+    spec = P(None, "sp", None, None)
+
+    def f(ql, kl, vl):
+        return ring_attention(ql, kl, vl, "sp", causal=causal)
+
+    fn = shard_map_compat(f, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return jax.jit(fn)(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_shards", [4, 8])
+def test_ring_matches_dense(causal, n_shards):
+    rng = np.random.RandomState(0)
+    B, L, H, D = 2, 64, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32))
+    out = _run_ring(q, k, v, n_shards, causal)
+    ref = full_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_single_shard_degenerates_to_dense():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.normal(size=(1, 16, 1, 8)).astype(np.float32))
+    out = _run_ring(q, q, q, 1, causal=False)
+    ref = full_attention_reference(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_composes_with_hips_mesh():
+    """3-D mesh: (dc, worker, sp) — geo data parallelism + sequence
+    parallelism in one program."""
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 1, 4)
+    mesh = Mesh(devs, axis_names=("dc", "worker", "sp"))
+    rng = np.random.RandomState(2)
+    B, L, H, D = 2, 32, 2, 8
+    # distinct sequences per dc (data parallel over dc; sp shards L)
+    q = jnp.asarray(rng.normal(size=(2 * B, L, H, D)).astype(np.float32))
+    spec = P("dc", "sp", None, None)
+
+    def f(ql):
+        return ring_attention(ql, ql, ql, "sp", causal=True)
+
+    fn = shard_map_compat(f, mesh, in_specs=(spec,), out_specs=spec)
+    out = jax.jit(fn)(q)
+    ref = full_attention_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
