@@ -1,0 +1,155 @@
+"""ctypes bindings for native/geops_runtime.cpp."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libgeops.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native runtime; None if unavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and build:
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               check=True, capture_output=True, timeout=120)
+            except (subprocess.SubprocessError, FileNotFoundError):
+                return None
+        if not os.path.exists(_LIB_PATH):
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        # queue
+        lib.gx_queue_create.restype = ctypes.c_void_p
+        lib.gx_queue_destroy.argtypes = [ctypes.c_void_p]
+        lib.gx_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64, ctypes.c_int64]
+        lib.gx_queue_push.restype = ctypes.c_int
+        lib.gx_queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.POINTER(ctypes.c_int64)]
+        lib.gx_queue_pop.restype = ctypes.c_int64
+        lib.gx_queue_size.argtypes = [ctypes.c_void_p]
+        lib.gx_queue_size.restype = ctypes.c_int64
+        lib.gx_queue_close.argtypes = [ctypes.c_void_p]
+        # tsengine
+        lib.gx_ts_create.argtypes = [ctypes.c_int, ctypes.c_double,
+                                     ctypes.c_uint64]
+        lib.gx_ts_create.restype = ctypes.c_void_p
+        lib.gx_ts_destroy.argtypes = [ctypes.c_void_p]
+        lib.gx_ts_report.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_int, ctypes.c_double,
+                                     ctypes.c_int64]
+        lib.gx_ts_ask.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_int64]
+        lib.gx_ts_ask.restype = ctypes.c_int
+        lib.gx_ts_ask1.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.gx_ts_ask1.restype = ctypes.c_int
+        lib.gx_ts_iters.argtypes = [ctypes.c_void_p]
+        lib.gx_ts_iters.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+class NativePriorityQueue:
+    """C++ priority send queue (drop-in for transport.PrioritySendQueue
+    for bytes payloads)."""
+
+    def __init__(self):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no toolchain?)")
+        self._lib = lib
+        self._q = lib.gx_queue_create()
+
+    def push(self, payload: bytes, priority: int = 0) -> None:
+        rc = self._lib.gx_queue_push(self._q, payload, len(payload),
+                                     priority)
+        if rc != 0:
+            raise RuntimeError("queue closed")
+
+    def pop(self, timeout: Optional[float] = None
+            ) -> Optional[Tuple[bytes, int]]:
+        """(payload, priority), or None on close/timeout."""
+        buf_len = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(buf_len)
+            prio = ctypes.c_int64()
+            req = ctypes.c_int64()
+            t = -1 if timeout is None else int(timeout * 1000)
+            n = self._lib.gx_queue_pop(self._q, buf, buf_len, t,
+                                       ctypes.byref(prio), ctypes.byref(req))
+            if n == -3:
+                buf_len = int(req.value)
+                continue
+            if n < 0:
+                return None
+            return buf.raw[:n], int(prio.value)
+
+    def close(self) -> None:
+        self._lib.gx_queue_close(self._q)
+
+    def __len__(self) -> int:
+        return int(self._lib.gx_queue_size(self._q))
+
+    def __del__(self):
+        try:
+            self._lib.gx_queue_destroy(self._q)
+        except Exception:
+            pass
+
+
+class NativeTSEngine:
+    """C++ TSEngine scheduler (same surface as transport.TSEngineScheduler)."""
+
+    STOP = -1
+
+    def __init__(self, num_nodes: int, max_greed_rate: float = 0.9,
+                 seed: int = 0):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no toolchain?)")
+        self._lib = lib
+        self._ts = lib.gx_ts_create(num_nodes, max_greed_rate, seed)
+        self.n = num_nodes
+
+    def report(self, sender: int, receiver: int, throughput: float,
+               version: int) -> None:
+        self._lib.gx_ts_report(self._ts, sender, receiver, throughput, version)
+
+    def ask(self, sender: int, version: int) -> int:
+        return int(self._lib.gx_ts_ask(self._ts, sender, version))
+
+    def ask1(self, node: int) -> Optional[Tuple[int, int]]:
+        out = (ctypes.c_int * 2)()
+        if self._lib.gx_ts_ask1(self._ts, node, out):
+            return int(out[0]), int(out[1])
+        return None
+
+    @property
+    def iters(self) -> int:
+        return int(self._lib.gx_ts_iters(self._ts))
+
+    def __del__(self):
+        try:
+            self._lib.gx_ts_destroy(self._ts)
+        except Exception:
+            pass

@@ -1,0 +1,18 @@
+"""Host-side parameter service: a real multi-process HiPS deployment.
+
+The SPMD path (geomx_tpu.train) covers everything synchronous inside one
+controller.  This package is the *process-topology* backend for the cases
+the reference needed actual servers for: genuinely asynchronous tiers
+(MixedSync), cross-controller deployments (each party its own JAX
+process/pod), and PS-style elasticity.  It mirrors the reference's
+process roles (SURVEY.md §1 "Node roles"): workers push to their party's
+local server; local servers aggregate and relay to the global server;
+pulls flow back down — over TCP with length-prefixed frames, priority
+send queues (P3), per-hop compression, and heartbeat liveness.
+"""
+
+from geomx_tpu.service.protocol import Msg, MsgType
+from geomx_tpu.service.server import GeoPSServer
+from geomx_tpu.service.client import GeoPSClient
+
+__all__ = ["Msg", "MsgType", "GeoPSServer", "GeoPSClient"]

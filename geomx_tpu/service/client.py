@@ -1,0 +1,191 @@
+"""GeoPSClient — worker-side connection to a PS tier.
+
+The process analogue of the reference's KVWorker
+(3rdparty/ps-lite/include/ps/kv_app.h:80-462):
+
+- ``push_async``/``pull_async`` return timestamps; ``wait(ts)`` blocks —
+  the reference's ZPush/ZPull + Wait on a Customer timestamp;
+- sends drain through a priority queue (native C++ when built), so
+  ``priority=-layer_idx`` pushes leave the host in layer order: the P3
+  send discipline (threadsafe_queue.h:19-60);
+- a receiver thread matches replies to requests by request id, like the
+  Customer recv thread tracking (timestamp -> response) pairs
+  (src/customer.cc:13-87).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from geomx_tpu.service.protocol import Msg, MsgType, recv_frame, send_frame
+
+
+class _Pending:
+    __slots__ = ("event", "reply")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply: Optional[Msg] = None
+
+
+class GeoPSClient:
+    def __init__(self, addr: Tuple[str, int], sender_id: int = 0):
+        self.sender_id = sender_id
+        self._sock = socket.create_connection(addr)
+        self._wlock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._pending: Dict[int, _Pending] = {}
+        self._plock = threading.Lock()
+        self._closed = False
+
+        self._sendq = self._make_queue()
+        self._native_q = type(self._sendq).__name__ == "NativePriorityQueue"
+        self._sender = threading.Thread(target=self._send_loop, daemon=True)
+        self._sender.start()
+        self._receiver = threading.Thread(target=self._recv_loop, daemon=True)
+        self._receiver.start()
+
+    @staticmethod
+    def _make_queue():
+        try:
+            from geomx_tpu.runtime import NativePriorityQueue, native_available
+            if native_available():
+                return NativePriorityQueue()
+        except Exception:
+            pass
+        from geomx_tpu.transport import PrioritySendQueue
+        return PrioritySendQueue()
+
+    # ---- send/recv machinery ----------------------------------------------
+
+    def _send_loop(self):
+        while True:
+            item = self._sendq.pop()
+            if item is None:
+                return
+            frame = item[0] if self._native_q else item
+            with self._wlock:
+                try:
+                    self._sock.sendall(
+                        len(frame).to_bytes(4, "little") + frame)
+                except OSError:
+                    return
+
+    def _recv_loop(self):
+        while not self._closed:
+            try:
+                msg = recv_frame(self._sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                # connection closed: release every waiter
+                with self._plock:
+                    for p in self._pending.values():
+                        p.event.set()
+                    self._pending = {}
+                return
+            rid = msg.meta.get("rid")
+            with self._plock:
+                p = self._pending.get(rid)
+            if p is not None:
+                p.reply = msg
+                p.event.set()
+
+    def _submit(self, msg: Msg, priority: int = 0) -> int:
+        """Enqueue a request; returns its timestamp (request id)."""
+        rid = next(self._rid)
+        msg.sender = self.sender_id
+        msg.meta["rid"] = rid
+        p = _Pending()
+        with self._plock:
+            self._pending[rid] = p
+        self._sendq.push(msg.encode(), priority)
+        return rid
+
+    def wait(self, rid: int, timeout: Optional[float] = None) -> Msg:
+        """Block until request `rid` completes (reference Customer::Wait)."""
+        with self._plock:
+            p = self._pending.get(rid)
+        if p is None:
+            raise KeyError(f"unknown timestamp {rid}")
+        ok = p.event.wait(timeout)
+        with self._plock:
+            self._pending.pop(rid, None)
+        if not ok:
+            raise TimeoutError(f"request {rid} timed out")
+        if p.reply is None:
+            raise ConnectionError("server closed")
+        if p.reply.type == MsgType.ERROR:
+            raise RuntimeError(p.reply.meta.get("error", "server error"))
+        return p.reply
+
+    def _request(self, msg: Msg, priority: int = 0,
+                 timeout: Optional[float] = 60.0) -> Msg:
+        return self.wait(self._submit(msg, priority), timeout)
+
+    # ---- KVWorker surface --------------------------------------------------
+
+    def init(self, key: str, value: np.ndarray) -> None:
+        self._request(Msg(MsgType.INIT, key=key,
+                          array=np.asarray(value, np.float32)))
+
+    def push(self, key: str, grad: np.ndarray, priority: int = 0) -> None:
+        self.wait(self.push_async(key, grad, priority))
+
+    def push_async(self, key: str, grad: np.ndarray, priority: int = 0) -> int:
+        return self._submit(Msg(MsgType.PUSH, key=key,
+                                array=np.asarray(grad, np.float32)),
+                            priority=priority)
+
+    def pull(self, key: str, priority: int = 0,
+             timeout: Optional[float] = 60.0) -> np.ndarray:
+        reply = self.wait(self.pull_async(key, priority), timeout)
+        return np.asarray(reply.array, np.float32)
+
+    def pull_async(self, key: str, priority: int = 0) -> int:
+        return self._submit(Msg(MsgType.PULL, key=key), priority=priority)
+
+    def barrier(self, timeout: Optional[float] = 120.0) -> None:
+        """Tier-wide barrier (reference kvstore.py:_barrier): returns once
+        every expected worker has entered."""
+        reply = self._request(Msg(MsgType.BARRIER), timeout=timeout)
+        if reply.type != MsgType.BARRIER_RELEASE:
+            raise ConnectionError(f"barrier failed: {reply}")
+
+    def set_optimizer(self, name: str, **kwargs) -> None:
+        self._request(Msg(MsgType.COMMAND,
+                          meta={"cmd": "set_optimizer", "name": name,
+                                "kwargs": kwargs}))
+
+    def set_gradient_compression(self, spec: str) -> None:
+        self._request(Msg(MsgType.COMMAND,
+                          meta={"cmd": "set_gradient_compression",
+                                "spec": spec}))
+
+    def num_dead_nodes(self, timeout: Optional[float] = None) -> int:
+        reply = self._request(Msg(MsgType.COMMAND,
+                                  meta={"cmd": "num_dead_nodes",
+                                        "timeout": timeout}))
+        return len(reply.meta["dead"])
+
+    def heartbeat(self) -> None:
+        self._request(Msg(MsgType.HEARTBEAT))
+
+    def stop_server(self) -> None:
+        try:
+            self._request(Msg(MsgType.STOP), timeout=5.0)
+        except (ConnectionError, OSError, TimeoutError):
+            pass
+
+    def close(self) -> None:
+        self._closed = True
+        self._sendq.close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

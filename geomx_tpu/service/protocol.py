@@ -1,0 +1,118 @@
+"""Wire protocol: length-prefixed frames, numpy payloads.
+
+The reference serializes ps-lite Meta via protobuf plus raw SArray data
+(3rdparty/ps-lite/include/ps/internal/message.h, src/meta.pb.cc).  Here a
+frame is:
+
+    [u32 header_len][header: pickled dict][payload bytes]
+
+with tensor payloads as raw little-endian numpy bytes described by
+header["dtype"]/header["shape"].  Pickle never carries user code — headers
+are dicts of primitives only (enforced in Msg).
+"""
+
+from __future__ import annotations
+
+import enum
+import io
+import pickle
+import socket
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_LEN = struct.Struct("<I")
+
+_ALLOWED_HEADER_TYPES = (str, int, float, bool, bytes, type(None), list,
+                         tuple, dict)
+
+
+class MsgType(enum.IntEnum):
+    INIT = 1
+    PUSH = 2
+    PULL = 3
+    PULL_REPLY = 4
+    BARRIER = 5
+    BARRIER_RELEASE = 6
+    HEARTBEAT = 7
+    COMMAND = 8          # set_optimizer / set_compression / profiler
+    ACK = 9
+    STOP = 10            # reference kStopServer
+    ERROR = 11
+
+
+@dataclass
+class Msg:
+    type: MsgType
+    key: Optional[str] = None
+    sender: int = -1
+    meta: Dict[str, Any] = field(default_factory=dict)
+    array: Optional[np.ndarray] = None
+
+    def _check_meta(self, obj, depth=0):
+        if depth > 6:
+            raise ValueError("meta too deep")
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                self._check_meta(k, depth + 1)
+                self._check_meta(v, depth + 1)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                self._check_meta(v, depth + 1)
+        elif not isinstance(obj, _ALLOWED_HEADER_TYPES):
+            raise ValueError(f"disallowed meta type {type(obj)}")
+
+    def encode(self) -> bytes:
+        self._check_meta(self.meta)
+        header = {"t": int(self.type), "k": self.key, "s": self.sender,
+                  "m": self.meta}
+        payload = b""
+        if self.array is not None:
+            arr = np.ascontiguousarray(self.array)
+            header["dtype"] = arr.dtype.str
+            header["shape"] = arr.shape
+            payload = arr.tobytes()
+        hb = pickle.dumps(header, protocol=4)
+        return _LEN.pack(len(hb)) + hb + payload
+
+    @classmethod
+    def decode(cls, frame: bytes) -> "Msg":
+        hlen = _LEN.unpack_from(frame, 0)[0]
+        header = pickle.loads(frame[4:4 + hlen])
+        arr = None
+        if "dtype" in header:
+            arr = np.frombuffer(frame[4 + hlen:],
+                                dtype=np.dtype(header["dtype"]))
+            arr = arr.reshape(header["shape"])
+        return cls(type=MsgType(header["t"]), key=header["k"],
+                   sender=header["s"], meta=header["m"], array=arr)
+
+
+def send_frame(sock: socket.socket, msg: Msg) -> None:
+    data = msg.encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Msg]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    data = _recv_exact(sock, n)
+    if data is None:
+        return None
+    return Msg.decode(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = io.BytesIO()
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            return None
+        buf.write(chunk)
+        got += len(chunk)
+    return buf.getvalue()

@@ -1,0 +1,350 @@
+"""GeoPSServer — one PS tier as a process.
+
+Runs the role of the reference's KVStoreDistServer
+(src/kvstore/kvstore_dist_server.h): accepts worker connections, merges
+pushes per key, gates on the sync count, optionally applies a server-side
+optimizer, and answers pulls.  Configured as a **local** server it also
+acts as a client of a **global** server (the dual identity of reference
+server nodes, ps.h:52-58): once its own workers' pushes are merged it
+relays the aggregate up and refreshes its store from the global reply
+before releasing its workers' pulls — the HiPS push-through
+(DataPushToGlobalServers*, kvstore_dist_server.h:745-780).
+
+Sync modes:
+- "sync"  — wait for all expected workers each round (FSA tier);
+- "async" — apply each push on arrival (MixedSync tier).
+
+Compression: the upward hop can be compressed ("fp16" / "bsc,r"); BSC
+payloads travel as (2k,) value+index vectors exactly like the reference's
+wire buffers, decompressed here (server-side BSCDecompress).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from geomx_tpu.service.protocol import Msg, MsgType, recv_frame, send_frame
+from geomx_tpu.utils.heartbeat import HeartbeatMonitor
+
+
+class _KeyState:
+    def __init__(self, value: np.ndarray):
+        self.value = value.copy()
+        self.merged: Optional[np.ndarray] = None
+        self.count = 0
+        self.round = 0            # completed merge rounds
+        self.pushed: Dict[int, int] = {}   # sender -> rounds pushed
+        self.waiting_pulls = []   # (conn, rid, round_needed) until merged
+
+
+class GeoPSServer:
+    _next_gid = 1000
+    _gid_lock = threading.Lock()
+
+    def __init__(self, port: int = 0, num_workers: int = 1,
+                 mode: str = "sync", optimizer=None,
+                 global_addr: Optional[tuple] = None,
+                 compression: Optional[str] = None,
+                 heartbeat_timeout: float = 15.0,
+                 accumulate: bool = False,
+                 global_sender_id: Optional[int] = None):
+        """``accumulate=True`` makes the no-optimizer store add pushes into
+        the value instead of overwriting it — the ps-lite default server
+        handle (KVServerDefaultHandle), used by its micro-tests; overwrite
+        is the GeoMX local-tier behavior (CopyFromTo merged->store)."""
+        self.num_workers = num_workers
+        self.mode = mode
+        self.accumulate = accumulate
+        self._tx = optimizer
+        self._opt_state: Dict[str, Any] = {}
+        self._store: Dict[str, _KeyState] = {}
+        self._lock = threading.Lock()
+        self._barrier_waiters = []
+        self._stops = 0
+        self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
+
+        self._global_addr = global_addr
+        self._global_sock: Optional[socket.socket] = None
+        # this server's identity at the global tier (the reference's second
+        # node identity my_node_global_, van.h:100); must be unique per party
+        if global_sender_id is None:
+            with GeoPSServer._gid_lock:
+                global_sender_id = GeoPSServer._next_gid
+                GeoPSServer._next_gid += 1
+        self._global_sender_id = global_sender_id
+        self._compressor = None
+        if compression:
+            from geomx_tpu.compression import get_compressor
+            self._compressor = get_compressor(compression)
+            self._comp_state: Dict[str, Any] = {}
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", port))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._global_addr is not None:
+            self._global_sock = socket.create_connection(self._global_addr)
+        self._accept_thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._global_sock is not None:
+            try:
+                send_frame(self._global_sock, Msg(MsgType.STOP))
+                self._global_sock.close()
+            except OSError:
+                pass
+
+    def join(self, timeout: Optional[float] = None):
+        self._accept_thread.join(timeout)
+
+    # ---- networking --------------------------------------------------------
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        while True:
+            try:
+                msg = recv_frame(conn)
+            except OSError:
+                return
+            if msg is None:
+                return
+            try:
+                stop = self._handle(conn, msg)
+            except Exception as e:  # surface server errors to the client
+                self._reply(conn, msg, Msg(MsgType.ERROR, meta={"error": repr(e)}))
+                continue
+            if stop:
+                return
+
+    # ---- request handling (the DataHandleEx dispatch) ----------------------
+
+    @staticmethod
+    def _reply(conn, req: Msg, reply: Msg):
+        """Echo the request id so async clients can match replies."""
+        rid = req.meta.get("rid")
+        if rid is not None:
+            reply.meta["rid"] = rid
+        send_frame(conn, reply)
+
+    def _handle(self, conn, msg: Msg) -> bool:
+        t = msg.type
+        if msg.sender >= 0:
+            self.heartbeats.heartbeat(msg.sender)
+        if t == MsgType.HEARTBEAT:
+            self._reply(conn, msg, Msg(MsgType.ACK))
+        elif t == MsgType.INIT:
+            with self._lock:
+                if msg.key not in self._store:
+                    self._store[msg.key] = _KeyState(msg.array)
+                    if self._tx is not None:
+                        self._opt_state[msg.key] = self._tx.init(msg.array)
+                    if self._compressor is not None:
+                        self._comp_state[msg.key] = \
+                            self._compressor.init_leaf_state(msg.array)
+            self._reply(conn, msg, Msg(MsgType.ACK, key=msg.key))
+        elif t == MsgType.PUSH:
+            self._handle_push(conn, msg)
+        elif t == MsgType.PULL:
+            self._handle_pull(conn, msg)
+        elif t == MsgType.BARRIER:
+            with self._lock:
+                self._barrier_waiters.append((conn, msg.meta.get("rid")))
+                if len(self._barrier_waiters) >= self.num_workers:
+                    for c, rid in self._barrier_waiters:
+                        rel = Msg(MsgType.BARRIER_RELEASE)
+                        if rid is not None:
+                            rel.meta["rid"] = rid
+                        send_frame(c, rel)
+                    self._barrier_waiters = []
+        elif t == MsgType.COMMAND:
+            self._handle_command(conn, msg)
+        elif t == MsgType.STOP:
+            with self._lock:
+                self._stops += 1
+                done = self._stops >= self.num_workers
+            self._reply(conn, msg, Msg(MsgType.ACK))
+            if done:
+                self.stop()
+            return True
+        else:
+            self._reply(conn, msg, Msg(MsgType.ERROR,
+                                       meta={"error": f"bad type {t}"}))
+        return False
+
+    def _handle_command(self, conn, msg: Msg):
+        cmd = msg.meta.get("cmd")
+        if cmd == "set_optimizer":
+            # reference pickles the optimizer to the server (kController);
+            # here only a named optax optimizer + kwargs travel the wire
+            from geomx_tpu.optim import get_optimizer
+            self._tx = get_optimizer(msg.meta["name"],
+                                     **msg.meta.get("kwargs", {}))
+            with self._lock:
+                for k, st in self._store.items():
+                    self._opt_state[k] = self._tx.init(st.value)
+        elif cmd == "set_gradient_compression":
+            from geomx_tpu.compression import get_compressor
+            self._compressor = get_compressor(msg.meta["spec"])
+            with self._lock:
+                self._comp_state = {
+                    k: self._compressor.init_leaf_state(st.value)
+                    for k, st in self._store.items()}
+        elif cmd == "num_dead_nodes":
+            self._reply(conn, msg, Msg(
+                MsgType.ACK,
+                meta={"dead": self.heartbeats.dead_nodes(
+                    msg.meta.get("timeout"))}))
+            return
+        else:
+            self._reply(conn, msg, Msg(MsgType.ERROR,
+                                       meta={"error": f"bad cmd {cmd}"}))
+            return
+        self._reply(conn, msg, Msg(MsgType.ACK))
+
+    # ---- the data path -----------------------------------------------------
+
+    def _apply(self, key: str, grad: np.ndarray):
+        """Merged gradient -> store (optimizer if present, else overwrite —
+        the reference's ApplyUpdates, kvstore_dist_server.h:502-523)."""
+        st = self._store[key]
+        if self._tx is not None:
+            import jax.numpy as jnp
+            import optax
+            updates, self._opt_state[key] = self._tx.update(
+                jnp.asarray(grad), self._opt_state[key],
+                jnp.asarray(st.value))
+            st.value = np.asarray(optax.apply_updates(
+                jnp.asarray(st.value), updates))
+        elif self.accumulate:
+            st.value = st.value + grad.astype(st.value.dtype)
+        else:
+            st.value = grad.astype(st.value.dtype)
+
+    def _relay_to_global(self, key: str, grad: np.ndarray) -> np.ndarray:
+        """Push the party aggregate up, pull fresh globals back
+        (DataPushToGlobalServers* + DataPullFromGlobalServers*)."""
+        meta = {}
+        payload = grad
+        if self._compressor is not None and \
+                self._compressor.name in ("bsc", "mpq"):
+            import jax.numpy as jnp
+            comp = self._compressor
+            state = self._comp_state[key]
+            if hasattr(comp, "compress") and state != ():
+                u, v = state
+                vals, idx, u, v = comp.compress(
+                    jnp.asarray(grad.reshape(-1)), u.reshape(-1),
+                    v.reshape(-1))
+                self._comp_state[key] = (np.asarray(u).reshape(grad.shape),
+                                         np.asarray(v).reshape(grad.shape))
+                payload = np.concatenate([np.asarray(vals),
+                                          np.asarray(idx, np.float32)])
+                meta = {"comp": "bsc", "n": int(grad.size),
+                        "shape": list(grad.shape)}
+        elif self._compressor is not None and self._compressor.name == "fp16":
+            payload = grad.astype(np.float16)
+        push = Msg(MsgType.PUSH, key=key, meta=meta, array=payload)
+        push.sender = self._global_sender_id
+        send_frame(self._global_sock, push)
+        reply = recv_frame(self._global_sock)
+        if reply is None or reply.type == MsgType.ERROR:
+            raise RuntimeError(f"global relay failed: {reply}")
+        pull = Msg(MsgType.PULL, key=key)
+        pull.sender = self._global_sender_id
+        send_frame(self._global_sock, pull)
+        pulled = recv_frame(self._global_sock)
+        return np.asarray(pulled.array, np.float32)
+
+    def _decompress_incoming(self, msg: Msg) -> np.ndarray:
+        if msg.meta.get("comp") == "bsc":
+            n = msg.meta["n"]
+            pairs = np.asarray(msg.array, np.float32)
+            k = pairs.size // 2
+            vals, idx = pairs[:k], pairs[k:].astype(np.int64)
+            out = np.zeros((n,), np.float32)
+            valid = idx >= 0
+            np.add.at(out, idx[valid], vals[valid])
+            return out.reshape(msg.meta["shape"])
+        return np.asarray(msg.array, np.float32)
+
+    def _handle_push(self, conn, msg: Msg):
+        key = msg.key
+        grad = self._decompress_incoming(msg)
+        with self._lock:
+            st = self._store[key]
+            if self.mode == "async":
+                # arrival-ordered apply (DataHandleAsyncDefault)
+                if self._global_sock is not None:
+                    fresh = self._relay_to_global(key, grad)
+                    st.value = fresh
+                else:
+                    self._apply(key, grad)
+                self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+                return
+            st.merged = grad if st.merged is None else st.merged + grad
+            st.count += 1
+            st.pushed[msg.sender] = st.pushed.get(msg.sender, 0) + 1
+            self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+            if st.count >= self.num_workers:
+                merged, st.merged, st.count = st.merged, None, 0
+                if self._global_sock is not None:
+                    st.value = self._relay_to_global(key, merged)
+                else:
+                    self._apply(key, merged)
+                st.round += 1
+                still = []
+                for c, rid, need in st.waiting_pulls:
+                    if st.round >= need:
+                        reply = Msg(MsgType.PULL_REPLY, key=key,
+                                    array=st.value)
+                        if rid is not None:
+                            reply.meta["rid"] = rid
+                        send_frame(c, reply)
+                    else:
+                        still.append((c, rid, need))
+                st.waiting_pulls = still
+
+    def _handle_pull(self, conn, msg: Msg):
+        with self._lock:
+            st = self._store.get(msg.key)
+            if st is None:
+                self._reply(conn, msg, Msg(MsgType.ERROR,
+                                           meta={"error": f"no key {msg.key}"}))
+                return
+            # a puller that has contributed to round r must see the post-r
+            # value; pulls never wait on rounds they did not join (that
+            # deadlocks cross-worker pipelining — the reference gates on
+            # per-round request bookkeeping, kvstore_dist_server.h:1138-1168)
+            need = st.pushed.get(msg.sender, 0)
+            if self.mode == "sync" and st.round < need:
+                st.waiting_pulls.append((conn, msg.meta.get("rid"), need))
+                return
+            self._reply(conn, msg, Msg(MsgType.PULL_REPLY, key=msg.key,
+                                       array=st.value))

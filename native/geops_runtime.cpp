@@ -1,0 +1,236 @@
+// geops native runtime: the host-side scheduling core.
+//
+// TPU-native counterpart of the reference's native transport internals:
+// - a thread-safe max-priority send queue with FIFO tie-breaking
+//   (reference: ps-lite ThreadsafeQueue, threadsafe_queue.h:19-60 — the
+//   P3 scheduler core);
+// - the TSEngine overlay scheduler state machine (reference: Van::
+//   ProcessAskCommand / ProcessAsk1Command, van.cc:1240-1435).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).  The Python
+// layer (geomx_tpu/runtime/) loads it when built and falls back to the
+// pure-Python implementations otherwise.
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Priority send queue
+// ---------------------------------------------------------------------------
+
+struct GxMessage {
+  std::vector<uint8_t> payload;
+  int64_t priority;
+  uint64_t seq;  // FIFO tie-break among equal priorities
+};
+
+struct GxCompare {
+  bool operator()(const GxMessage* a, const GxMessage* b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;  // earlier seq wins
+  }
+};
+
+struct GxQueue {
+  std::priority_queue<GxMessage*, std::vector<GxMessage*>, GxCompare> heap;
+  std::mutex mu;
+  std::condition_variable cv;
+  uint64_t next_seq = 0;
+  bool closed = false;
+};
+
+void* gx_queue_create() { return new GxQueue(); }
+
+void gx_queue_destroy(void* q) {
+  auto* gq = static_cast<GxQueue*>(q);
+  std::unique_lock<std::mutex> lk(gq->mu);
+  while (!gq->heap.empty()) {
+    delete gq->heap.top();
+    gq->heap.pop();
+  }
+  lk.unlock();
+  delete gq;
+}
+
+int gx_queue_push(void* q, const uint8_t* data, int64_t len, int64_t priority) {
+  auto* gq = static_cast<GxQueue*>(q);
+  std::lock_guard<std::mutex> lk(gq->mu);
+  if (gq->closed) return -1;
+  auto* msg = new GxMessage();
+  msg->payload.assign(data, data + len);
+  msg->priority = priority;
+  msg->seq = gq->next_seq++;
+  gq->heap.push(msg);
+  gq->cv.notify_one();
+  return 0;
+}
+
+// Pops the highest-priority message into caller-provided buffer.
+// Returns payload length, -1 on closed-and-empty, -2 on timeout,
+// -3 if the buffer is too small (message stays queued; required size is
+// written to *out_required).
+int64_t gx_queue_pop(void* q, uint8_t* buf, int64_t buf_len,
+                     int64_t timeout_ms, int64_t* out_priority,
+                     int64_t* out_required) {
+  auto* gq = static_cast<GxQueue*>(q);
+  std::unique_lock<std::mutex> lk(gq->mu);
+  auto ready = [&] { return !gq->heap.empty() || gq->closed; };
+  if (timeout_ms < 0) {
+    gq->cv.wait(lk, ready);
+  } else if (!gq->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                              ready)) {
+    return -2;
+  }
+  if (gq->heap.empty()) return -1;  // closed
+  GxMessage* msg = gq->heap.top();
+  int64_t n = static_cast<int64_t>(msg->payload.size());
+  if (out_required) *out_required = n;
+  if (n > buf_len) return -3;
+  gq->heap.pop();
+  std::memcpy(buf, msg->payload.data(), n);
+  if (out_priority) *out_priority = msg->priority;
+  delete msg;
+  return n;
+}
+
+int64_t gx_queue_size(void* q) {
+  auto* gq = static_cast<GxQueue*>(q);
+  std::lock_guard<std::mutex> lk(gq->mu);
+  return static_cast<int64_t>(gq->heap.size());
+}
+
+void gx_queue_close(void* q) {
+  auto* gq = static_cast<GxQueue*>(q);
+  std::lock_guard<std::mutex> lk(gq->mu);
+  gq->closed = true;
+  gq->cv.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// TSEngine overlay scheduler
+// ---------------------------------------------------------------------------
+
+struct GxTs {
+  int n;
+  double max_greed;
+  uint64_t rng;  // xorshift state
+  std::vector<std::vector<double>> A;     // throughput i->j; <0 = unknown
+  std::vector<std::vector<int64_t>> life; // measurement round
+  std::vector<uint8_t> busy;
+  int64_t iters = 0;
+  std::vector<int> ask_q;                 // push pairing queue
+  std::vector<uint8_t> push_done;
+  std::mutex mu;
+};
+
+static uint64_t gx_next(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  *s = x;
+  return x;
+}
+
+void* gx_ts_create(int num_nodes, double max_greed_rate, uint64_t seed) {
+  auto* ts = new GxTs();
+  ts->n = num_nodes;
+  ts->max_greed = max_greed_rate;
+  ts->rng = seed ? seed : 0x9E3779B97F4A7C15ull;
+  ts->A.assign(num_nodes, std::vector<double>(num_nodes, -1.0));
+  ts->life.assign(num_nodes, std::vector<int64_t>(num_nodes, -1));
+  ts->busy.assign(num_nodes, 0);
+  ts->push_done.assign(num_nodes, 0);
+  return ts;
+}
+
+void gx_ts_destroy(void* p) { delete static_cast<GxTs*>(p); }
+
+void gx_ts_report(void* p, int sender, int receiver, double throughput,
+                  int64_t version) {
+  auto* ts = static_cast<GxTs*>(p);
+  std::lock_guard<std::mutex> lk(ts->mu);
+  ts->A[sender][receiver] = throughput;
+  ts->life[sender][receiver] = version;
+}
+
+// Epsilon-greedy receiver choice (ProcessAskCommand).  Returns the
+// receiver id, or -1 for STOP.
+int gx_ts_ask(void* p, int sender, int64_t version) {
+  auto* ts = static_cast<GxTs*>(p);
+  std::lock_guard<std::mutex> lk(ts->mu);
+  bool all_busy = true;
+  for (auto b : ts->busy) all_busy &= (b != 0);
+  if (all_busy) {
+    std::fill(ts->busy.begin(), ts->busy.end(), 0);
+    ts->iters++;
+  }
+  if (version <= ts->iters) return -1;
+  std::vector<int> known, unknown;
+  for (int j = 0; j < ts->n; ++j) {
+    if (ts->busy[j]) continue;
+    (ts->A[sender][j] >= 0 ? known : unknown).push_back(j);
+  }
+  if (known.empty() && unknown.empty()) return -1;
+  double greed =
+      static_cast<double>(known.size()) / (known.size() + unknown.size());
+  greed = std::min(greed, ts->max_greed);
+  int receiver;
+  double u = (gx_next(&ts->rng) >> 11) * (1.0 / 9007199254740992.0);
+  if (!known.empty() && u < greed) {
+    receiver = known[0];
+    for (int j : known)
+      if (ts->A[sender][j] > ts->A[sender][receiver]) receiver = j;
+  } else {
+    const auto& pool = unknown.empty() ? known : unknown;
+    receiver = pool[gx_next(&ts->rng) % pool.size()];
+  }
+  ts->busy[receiver] = 1;
+  return receiver;
+}
+
+// Push pairing (ProcessAsk1Command).  On pairing, writes {sender,
+// receiver} into out[0..1] and returns 1; returns 0 when queued waiting
+// for a partner (or duplicate ask).
+int gx_ts_ask1(void* p, int node, int* out) {
+  auto* ts = static_cast<GxTs*>(p);
+  std::lock_guard<std::mutex> lk(ts->mu);
+  if (ts->ask_q.size() == 1 && ts->ask_q[0] == node) return 0;
+  ts->ask_q.push_back(node);
+  if (ts->ask_q.size() < 2) return 0;
+  int a = ts->ask_q[0], b = ts->ask_q[1];
+  ts->ask_q.erase(ts->ask_q.begin(), ts->ask_q.begin() + 2);
+  int sender, receiver;
+  if (a == 0 || b == 0) {
+    sender = (a == 0) ? b : a;
+    receiver = 0;
+  } else if (ts->A[a][b] > ts->A[b][a]) {
+    sender = a;
+    receiver = b;
+  } else {
+    sender = b;
+    receiver = a;
+  }
+  ts->push_done[sender] = 1;
+  bool done = true;
+  for (int i = 1; i < ts->n; ++i) done &= (ts->push_done[i] != 0);
+  if (done) std::fill(ts->push_done.begin(), ts->push_done.end(), 0);
+  out[0] = sender;
+  out[1] = receiver;
+  return 1;
+}
+
+int64_t gx_ts_iters(void* p) {
+  auto* ts = static_cast<GxTs*>(p);
+  std::lock_guard<std::mutex> lk(ts->mu);
+  return ts->iters;
+}
+
+}  // extern "C"

@@ -1,0 +1,201 @@
+"""Host-side PS service tests.
+
+Modelled on the reference's ps-lite micro-tests
+(3rdparty/ps-lite/tests/test_kv_app.cc:1-62 — N workers push repeatedly,
+assert pulls equal the expected aggregate), with the multi-node topology
+simulated by threads on localhost exactly as the reference's tests/
+local.sh simulates it with processes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.service import GeoPSClient, GeoPSServer
+
+
+def test_single_tier_sync_push_pull():
+    """test_kv_app parity: repeated synchronized pushes, pull == sum."""
+    server = GeoPSServer(num_workers=3, mode="sync", accumulate=True).start()
+    clients = [GeoPSClient(("127.0.0.1", server.port), sender_id=i)
+               for i in range(3)]
+    n = 1000
+    for c in clients:
+        c.init("w", np.zeros(n, np.float32))
+    repeat = 10
+    errs = []
+
+    def worker(c, wid):
+        try:
+            for r in range(repeat):
+                c.push("w", np.full(n, 1.0 + wid, np.float32))
+                out = c.pull("w")
+                expect = (r + 1) * (1.0 + 2.0 + 3.0)
+                if not np.allclose(out, expect):
+                    errs.append((wid, r, out[0], expect))
+        except Exception as e:
+            errs.append((wid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(c, i))
+               for i, c in enumerate(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    for c in clients:
+        c.stop_server()
+        c.close()
+
+
+def test_barrier_blocks_until_all_enter():
+    server = GeoPSServer(num_workers=2).start()
+    c0 = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    c1 = GeoPSClient(("127.0.0.1", server.port), sender_id=1)
+    order = []
+
+    def late():
+        time.sleep(0.2)
+        order.append("enter1")
+        c1.barrier()
+
+    t = threading.Thread(target=late)
+    t.start()
+    order.append("enter0")
+    c0.barrier()
+    order.append("released")
+    t.join(timeout=10)
+    assert order == ["enter0", "enter1", "released"]
+    server.stop()
+
+
+def test_two_tier_hips_relay():
+    """2 parties x 2 workers + global server: the full HiPS dataflow
+    (worker push -> local merge -> global merge -> pull back down)."""
+    gs = GeoPSServer(num_workers=2, mode="sync").start()  # 2 global workers
+    locals_ = [GeoPSServer(num_workers=2, mode="sync",
+                           global_addr=("127.0.0.1", gs.port)).start()
+               for _ in range(2)]
+    n = 256
+    workers = []
+    for p, ls in enumerate(locals_):
+        for w in range(2):
+            workers.append((p, GeoPSClient(("127.0.0.1", ls.port),
+                                           sender_id=w)))
+    # local INIT must also register the key at the global tier: the local
+    # server relays on first merge, so init globals first via a direct client
+    ginit = GeoPSClient(("127.0.0.1", gs.port), sender_id=99)
+    ginit.init("w", np.zeros(n, np.float32))
+    for _, c in workers:
+        c.init("w", np.zeros(n, np.float32))
+
+    results = {}
+    errs = []
+
+    def run(p, wid, c):
+        try:
+            c.push("w", np.full(n, 1.0, np.float32))
+            results[(p, wid)] = c.pull("w")
+        except Exception as e:
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=run, args=(p, i, c))
+               for i, (p, c) in enumerate(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    # each party merges 2 pushes of 1.0 -> 2.0; global merges 2 parties -> 4.0
+    for k, v in results.items():
+        np.testing.assert_allclose(v, 4.0, err_msg=str(k))
+    for ls in locals_:
+        ls.stop()
+    gs.stop()
+
+
+def test_async_mode_with_optimizer():
+    """dist_async tier: pushes apply on arrival through the server-side
+    optimizer (reference DataHandleAsyncDefault + python updater)."""
+    server = GeoPSServer(num_workers=2, mode="async").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    c.init("w", np.zeros(4, np.float32))
+    c.set_optimizer("sgd", learning_rate=0.1)
+    c.push("w", np.ones(4, np.float32))
+    np.testing.assert_allclose(c.pull("w"), -0.1, rtol=1e-6)
+    c.push("w", np.ones(4, np.float32))
+    np.testing.assert_allclose(c.pull("w"), -0.2, rtol=1e-6)
+    server.stop()
+
+
+def test_bsc_compressed_relay():
+    """Local -> global hop with Bi-Sparse compression: sparse payload on
+    the wire, spikes survive, server-side decompression."""
+    gs = GeoPSServer(num_workers=1, mode="sync").start()
+    ls = GeoPSServer(num_workers=1, mode="sync",
+                     global_addr=("127.0.0.1", gs.port),
+                     compression="bsc,0.01").start()
+    n = 4096
+    ginit = GeoPSClient(("127.0.0.1", gs.port), sender_id=9)
+    ginit.init("w", np.zeros(n, np.float32))
+    c = GeoPSClient(("127.0.0.1", ls.port), sender_id=0)
+    c.init("w", np.zeros(n, np.float32))
+    g = np.random.RandomState(0).normal(0, 1e-3, n).astype(np.float32)
+    g[123] = 9.0
+    g[456] = -7.0
+    c.push("w", g)
+    out = c.pull("w")
+    assert out[123] == pytest.approx(9.0, abs=0.01)
+    assert out[456] == pytest.approx(-7.0, abs=0.01)
+    assert (out != 0).sum() <= 2 * int(np.ceil(n * 0.01))
+    ls.stop()
+    gs.stop()
+
+
+def test_priority_ordering_on_the_wire():
+    """P3: queued pushes leave in priority order (front layers first)."""
+    server = GeoPSServer(num_workers=1, mode="async").start()
+    arrivals = []
+    orig = server._handle_push
+
+    def spy(conn, msg):
+        arrivals.append(msg.key)
+        return orig(conn, msg)
+
+    server._handle_push = spy
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    for i in range(4):
+        c.init(f"layer{i}", np.zeros(8, np.float32))
+    # stall the sender so all pushes queue, then release
+    with c._wlock:
+        rids = [c.push_async(f"layer{i}", np.ones(8, np.float32),
+                             priority=-i)
+                for i in (3, 1, 2, 0)]
+        time.sleep(0.1)
+    for r in rids:
+        c.wait(r, timeout=10)
+    assert arrivals == ["layer0", "layer1", "layer2", "layer3"]
+    server.stop()
+
+
+def test_dead_node_detection():
+    server = GeoPSServer(num_workers=1, heartbeat_timeout=0.2).start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=5)
+    monitor = GeoPSClient(("127.0.0.1", server.port), sender_id=-1)
+    c.heartbeat()
+    assert monitor.num_dead_nodes() == 0
+    time.sleep(0.3)
+    assert monitor.num_dead_nodes() == 1  # node 5 went silent
+    c.heartbeat()
+    assert monitor.num_dead_nodes() == 0  # recovery clears it (is_recovery)
+    server.stop()
+
+
+def test_error_reply_for_unknown_key():
+    server = GeoPSServer(num_workers=1).start()
+    c = GeoPSClient(("127.0.0.1", server.port))
+    with pytest.raises(RuntimeError, match="no key"):
+        c.pull("missing")
+    server.stop()
