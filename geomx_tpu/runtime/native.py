@@ -107,12 +107,24 @@ class NativePriorityQueue:
     def close(self) -> None:
         self._lib.gx_queue_close(self._q)
 
+    def destroy(self) -> None:
+        """Free the native queue.  Only call once no consumer thread can
+        re-enter pop(); gx_queue_destroy additionally drains in-flight
+        poppers (waiter count) before freeing."""
+        q, self._q = self._q, None
+        if q is not None:
+            self._lib.gx_queue_destroy(q)
+
     def __len__(self) -> int:
         return int(self._lib.gx_queue_size(self._q))
 
     def __del__(self):
+        # close (wakes blocked poppers) but deliberately do NOT destroy:
+        # a daemon sender thread may still loop back into pop(); the small
+        # native object is reclaimed at process exit instead.
         try:
-            self._lib.gx_queue_destroy(self._q)
+            if self._q is not None:
+                self._lib.gx_queue_close(self._q)
         except Exception:
             pass
 

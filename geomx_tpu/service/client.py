@@ -16,29 +16,45 @@ The process analogue of the reference's KVWorker
 from __future__ import annotations
 
 import itertools
+import random
 import socket
 import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu.service.protocol import Msg, MsgType, recv_frame, send_frame
+from geomx_tpu.service.protocol import (Msg, MsgType, env_int, recv_frame,
+                                        send_frame)
 
 
 class _Pending:
-    __slots__ = ("event", "reply")
+    __slots__ = ("event", "reply", "frame", "priority")
 
     def __init__(self):
         self.event = threading.Event()
         self.reply: Optional[Msg] = None
+        self.frame: Optional[bytes] = None   # kept for resend
+        self.priority: int = 0
 
 
 class GeoPSClient:
-    def __init__(self, addr: Tuple[str, int], sender_id: int = 0):
+    def __init__(self, addr: Tuple[str, int], sender_id: int = 0,
+                 resend_timeout_ms: Optional[int] = None):
         self.sender_id = sender_id
+        # reliability: when PS_RESEND/GEOMX_RESEND is on (or a timeout is
+        # given), un-ACKed requests are retransmitted after
+        # PS_RESEND_TIMEOUT ms — the reference Resender (src/resender.h);
+        # the server dedups replays by (sender, rid) signature.
+        if resend_timeout_ms is None and env_int(
+                ("GEOMX_RESEND", "PS_RESEND"), 0):
+            resend_timeout_ms = env_int(
+                ("GEOMX_RESEND_TIMEOUT", "PS_RESEND_TIMEOUT"), 1000)
+        self.resend_timeout_ms = resend_timeout_ms
         self._sock = socket.create_connection(addr)
         self._wlock = threading.Lock()
-        self._rid = itertools.count(1)
+        # random rid base so a restarted worker reusing a sender_id cannot
+        # collide with its predecessor's (sender, rid) dedup signatures
+        self._rid = itertools.count(random.getrandbits(31))
         self._pending: Dict[int, _Pending] = {}
         self._plock = threading.Lock()
         self._closed = False
@@ -102,18 +118,49 @@ class GeoPSClient:
         msg.sender = self.sender_id
         msg.meta["rid"] = rid
         p = _Pending()
+        # only data messages are retransmitted: PUSH is deduped server-side
+        # (flagged here), PULL is idempotent; control traffic (barrier,
+        # stop, command) is neither and is never dropped by fault injection
+        resendable = self.resend_timeout_ms is not None and \
+            msg.type in (MsgType.PUSH, MsgType.PULL)
+        if resendable:
+            # marks the frame droppable by fault injection and (for PUSH)
+            # enrolls it in the server's replay-dedup signature set
+            msg.meta["resend"] = True
+        frame = msg.encode()
+        if resendable:
+            p.frame, p.priority = frame, priority
         with self._plock:
             self._pending[rid] = p
-        self._sendq.push(msg.encode(), priority)
+        self._sendq.push(frame, priority)
         return rid
 
     def wait(self, rid: int, timeout: Optional[float] = None) -> Msg:
-        """Block until request `rid` completes (reference Customer::Wait)."""
+        """Block until request `rid` completes (reference Customer::Wait).
+        With resend enabled, the request is retransmitted each time the
+        resend timeout expires without a reply."""
         with self._plock:
             p = self._pending.get(rid)
         if p is None:
             raise KeyError(f"unknown timestamp {rid}")
-        ok = p.event.wait(timeout)
+        if self.resend_timeout_ms is None or p.frame is None:
+            ok = p.event.wait(timeout)
+        else:
+            import time as _time
+            deadline = None if timeout is None else \
+                _time.monotonic() + timeout
+            slice_s = self.resend_timeout_ms / 1000.0
+            while True:
+                remain = None if deadline is None else \
+                    deadline - _time.monotonic()
+                if remain is not None and remain <= 0:
+                    ok = p.event.is_set()
+                    break
+                w = slice_s if remain is None else min(slice_s, remain)
+                ok = p.event.wait(w)
+                if ok:
+                    break
+                self._sendq.push(p.frame, p.priority)  # retransmit
         with self._plock:
             self._pending.pop(rid, None)
         if not ok:
@@ -167,6 +214,25 @@ class GeoPSClient:
                           meta={"cmd": "set_gradient_compression",
                                 "spec": spec}))
 
+    # ---- remote profiler control (reference kSetProfilerParams,
+    # kvstore_dist.h:197-203: a worker configures/starts/dumps profilers on
+    # remote servers) ------------------------------------------------------
+    def set_profiler_params(self, **params) -> None:
+        self._request(Msg(MsgType.COMMAND,
+                          meta={"cmd": "set_profiler_params",
+                                "params": params}))
+
+    def profiler_start(self) -> None:
+        self._request(Msg(MsgType.COMMAND, meta={"cmd": "profiler_start"}))
+
+    def profiler_stop(self) -> None:
+        self._request(Msg(MsgType.COMMAND, meta={"cmd": "profiler_stop"}))
+
+    def profiler_dump(self) -> str:
+        reply = self._request(Msg(MsgType.COMMAND,
+                                  meta={"cmd": "profiler_dump"}))
+        return reply.meta["path"]
+
     def num_dead_nodes(self, timeout: Optional[float] = None) -> int:
         reply = self._request(Msg(MsgType.COMMAND,
                                   meta={"cmd": "num_dead_nodes",
@@ -189,3 +255,7 @@ class GeoPSClient:
             self._sock.close()
         except OSError:
             pass
+        # free the native queue only after the sender can no longer touch it
+        self._sender.join(timeout=2.0)
+        if self._native_q and not self._sender.is_alive():
+            self._sendq.destroy()

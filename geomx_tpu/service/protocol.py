@@ -90,6 +90,43 @@ class Msg:
                    sender=header["s"], meta=header["m"], array=arr)
 
 
+# ---- fault injection (reference PS_DROP_MSG, van.cc:510-512: received
+# data messages are dropped with the given percentage probability) ---------
+
+import random as _random
+
+_drop_rng = _random.Random(0xD209)
+
+
+def env_int(names, default: int) -> int:
+    """First-set env var among `names` wins (shared config._env parser, so
+    unparseable values raise like every other GEOMX_* knob)."""
+    from geomx_tpu.config import _env
+    return _env(names, default, int)
+
+
+def drop_rate() -> int:
+    """Drop percentage from GEOMX_DROP_MSG / PS_DROP_MSG (0-100)."""
+    return max(0, min(100, env_int(("GEOMX_DROP_MSG", "PS_DROP_MSG"), 0)))
+
+
+def should_drop(msg: Msg) -> bool:
+    """True if fault injection says to drop this *data* message.  Only
+    resend-protected traffic (meta["resend"], set by clients with the
+    Resender enabled) is droppable — the reference likewise only drops
+    through the Resender-covered path, and refuses PS_DROP_MSG without
+    PS_RESEND.  Control traffic and the local->global relay hop (which
+    blocks under the store lock with no resender) are never dropped."""
+    rate = drop_rate()
+    if rate <= 0:
+        return False
+    if msg.type not in (MsgType.PUSH, MsgType.PULL):
+        return False
+    if not msg.meta.get("resend") or msg.meta.get("reliable"):
+        return False
+    return _drop_rng.random() * 100.0 < rate
+
+
 def send_frame(sock: socket.socket, msg: Msg) -> None:
     data = msg.encode()
     sock.sendall(_LEN.pack(len(data)) + data)

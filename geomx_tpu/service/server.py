@@ -28,7 +28,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomx_tpu.service.protocol import Msg, MsgType, recv_frame, send_frame
+from geomx_tpu.service.protocol import (Msg, MsgType, recv_frame, send_frame,
+                                        should_drop)
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
 
 
@@ -52,7 +53,8 @@ class GeoPSServer:
                  compression: Optional[str] = None,
                  heartbeat_timeout: float = 15.0,
                  accumulate: bool = False,
-                 global_sender_id: Optional[int] = None):
+                 global_sender_id: Optional[int] = None,
+                 rank: int = 0):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -66,7 +68,13 @@ class GeoPSServer:
         self._lock = threading.Lock()
         self._barrier_waiters = []
         self._stops = 0
+        self._seen_pushes: Dict[Any, bool] = {}
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
+        self.rank = rank
+        # remotely-controllable profiler (reference kSetProfilerParams,
+        # kvstore_dist_server.h:383-430)
+        from geomx_tpu.utils.profiler import Profiler
+        self.profiler = Profiler(rank=rank)
 
         self._global_addr = global_addr
         self._global_sock: Optional[socket.socket] = None
@@ -135,6 +143,8 @@ class GeoPSServer:
                 return
             if msg is None:
                 return
+            if should_drop(msg):
+                continue  # fault injection: message "lost on the wire"
             try:
                 stop = self._handle(conn, msg)
             except Exception as e:  # surface server errors to the client
@@ -216,6 +226,16 @@ class GeoPSServer:
                 self._comp_state = {
                     k: self._compressor.init_leaf_state(st.value)
                     for k, st in self._store.items()}
+        elif cmd == "set_profiler_params":
+            self.profiler.set_config(**msg.meta.get("params", {}))
+        elif cmd == "profiler_start":
+            self.profiler.set_state(True)
+        elif cmd == "profiler_stop":
+            self.profiler.set_state(False)
+        elif cmd == "profiler_dump":
+            path = self.profiler.dump()
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={"path": path}))
+            return
         elif cmd == "num_dead_nodes":
             self._reply(conn, msg, Msg(
                 MsgType.ACK,
@@ -250,6 +270,10 @@ class GeoPSServer:
     def _relay_to_global(self, key: str, grad: np.ndarray) -> np.ndarray:
         """Push the party aggregate up, pull fresh globals back
         (DataPushToGlobalServers* + DataPullFromGlobalServers*)."""
+        with self.profiler.scope(f"RelayToGlobal:{key}", "comm"):
+            return self._relay_to_global_impl(key, grad)
+
+    def _relay_to_global_impl(self, key: str, grad: np.ndarray) -> np.ndarray:
         meta = {}
         payload = grad
         if self._compressor is not None and \
@@ -270,16 +294,22 @@ class GeoPSServer:
                         "shape": list(grad.shape)}
         elif self._compressor is not None and self._compressor.name == "fp16":
             payload = grad.astype(np.float16)
+        # the relay hop blocks under the store lock with no resender, so it
+        # opts out of drop injection (meta["reliable"])
+        meta["reliable"] = True
         push = Msg(MsgType.PUSH, key=key, meta=meta, array=payload)
         push.sender = self._global_sender_id
         send_frame(self._global_sock, push)
         reply = recv_frame(self._global_sock)
         if reply is None or reply.type == MsgType.ERROR:
             raise RuntimeError(f"global relay failed: {reply}")
-        pull = Msg(MsgType.PULL, key=key)
+        pull = Msg(MsgType.PULL, key=key, meta={"reliable": True})
         pull.sender = self._global_sender_id
         send_frame(self._global_sock, pull)
         pulled = recv_frame(self._global_sock)
+        if pulled is None or pulled.type == MsgType.ERROR or \
+                pulled.array is None:
+            raise RuntimeError(f"global relay pull failed: {pulled}")
         return np.asarray(pulled.array, np.float32)
 
     def _decompress_incoming(self, msg: Msg) -> np.ndarray:
@@ -295,41 +325,73 @@ class GeoPSServer:
         return np.asarray(msg.array, np.float32)
 
     def _handle_push(self, conn, msg: Msg):
+        with self.profiler.scope(f"ServerPush:{msg.key}", "kvstore"):
+            self._handle_push_profiled(conn, msg)
+
+    def _handle_push_profiled(self, conn, msg: Msg):
         key = msg.key
         grad = self._decompress_incoming(msg)
+        # resend dedup: a push is not idempotent (it merges), so replayed
+        # (sender, rid) signatures are re-ACKed without re-merging — the
+        # reference Resender's signature set (src/resender.h).  Only
+        # resend-flagged pushes participate: unflagged clients (fresh rid
+        # counters after a worker restart) must never match stale sigs.
+        sig = None
+        if msg.meta.get("resend") and msg.meta.get("rid") is not None \
+                and msg.sender >= 0:
+            sig = (msg.sender, msg.meta["rid"])
         with self._lock:
-            st = self._store[key]
-            if self.mode == "async":
-                # arrival-ordered apply (DataHandleAsyncDefault)
-                if self._global_sock is not None:
-                    fresh = self._relay_to_global(key, grad)
-                    st.value = fresh
-                else:
-                    self._apply(key, grad)
-                self._reply(conn, msg, Msg(MsgType.ACK, key=key))
-                return
-            st.merged = grad if st.merged is None else st.merged + grad
-            st.count += 1
-            st.pushed[msg.sender] = st.pushed.get(msg.sender, 0) + 1
+            if sig is not None:
+                if sig in self._seen_pushes:
+                    self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+                    return
+                # check-and-record atomically so concurrent replays can't
+                # both merge; rolled back below if processing fails so a
+                # retransmit can still succeed
+                self._seen_pushes[sig] = True
+                while len(self._seen_pushes) > 65536:
+                    self._seen_pushes.pop(next(iter(self._seen_pushes)))
+            try:
+                self._push_locked(conn, msg, key, grad)
+            except Exception:
+                if sig is not None:
+                    self._seen_pushes.pop(sig, None)
+                raise
+
+    def _push_locked(self, conn, msg: Msg, key: str, grad: np.ndarray):
+        """The merge/apply body; caller holds self._lock."""
+        st = self._store[key]
+        if self.mode == "async":
+            # arrival-ordered apply (DataHandleAsyncDefault)
+            if self._global_sock is not None:
+                fresh = self._relay_to_global(key, grad)
+                st.value = fresh
+            else:
+                self._apply(key, grad)
             self._reply(conn, msg, Msg(MsgType.ACK, key=key))
-            if st.count >= self.num_workers:
-                merged, st.merged, st.count = st.merged, None, 0
-                if self._global_sock is not None:
-                    st.value = self._relay_to_global(key, merged)
+            return
+        st.merged = grad if st.merged is None else st.merged + grad
+        st.count += 1
+        st.pushed[msg.sender] = st.pushed.get(msg.sender, 0) + 1
+        self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+        if st.count >= self.num_workers:
+            merged, st.merged, st.count = st.merged, None, 0
+            if self._global_sock is not None:
+                st.value = self._relay_to_global(key, merged)
+            else:
+                self._apply(key, merged)
+            st.round += 1
+            still = []
+            for c, rid, need in st.waiting_pulls:
+                if st.round >= need:
+                    reply = Msg(MsgType.PULL_REPLY, key=key,
+                                array=st.value)
+                    if rid is not None:
+                        reply.meta["rid"] = rid
+                    send_frame(c, reply)
                 else:
-                    self._apply(key, merged)
-                st.round += 1
-                still = []
-                for c, rid, need in st.waiting_pulls:
-                    if st.round >= need:
-                        reply = Msg(MsgType.PULL_REPLY, key=key,
-                                    array=st.value)
-                        if rid is not None:
-                            reply.meta["rid"] = rid
-                        send_frame(c, reply)
-                    else:
-                        still.append((c, rid, need))
-                st.waiting_pulls = still
+                    still.append((c, rid, need))
+            st.waiting_pulls = still
 
     def _handle_pull(self, conn, msg: Msg):
         with self._lock:
@@ -344,7 +406,12 @@ class GeoPSServer:
             # per-round request bookkeeping, kvstore_dist_server.h:1138-1168)
             need = st.pushed.get(msg.sender, 0)
             if self.mode == "sync" and st.round < need:
-                st.waiting_pulls.append((conn, msg.meta.get("rid"), need))
+                rid = msg.meta.get("rid")
+                # a resent PULL with the same rid must not queue twice —
+                # the original entry will answer it (one reply per request)
+                if rid is None or all(w[1] != rid
+                                      for w in st.waiting_pulls):
+                    st.waiting_pulls.append((conn, rid, need))
                 return
             self._reply(conn, msg, Msg(MsgType.PULL_REPLY, key=msg.key,
                                        array=st.value))

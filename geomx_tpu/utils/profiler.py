@@ -1,0 +1,186 @@
+"""Tracing/profiling: Chrome-trace event recording + XLA device traces.
+
+Parity with the reference's profiler subsystem (src/profiler/profiler.h:256,
+aggregate_stats.cc): named scopes are recorded as Chrome trace events and
+dumped to a ``chrome://tracing``-loadable JSON file; ``aggregate_stats()``
+reproduces the reference's per-name aggregate table (count/total/min/max/avg).
+Device-side profiling delegates to ``jax.profiler`` (start_trace/stop_trace
+TensorBoard traces and per-op annotations via TraceAnnotation), the TPU
+analogue of the reference's engine-thread operator profiling.
+
+The reference can also drive profilers on *remote PS servers* from a worker
+via kvstore commands (kSetProfilerParams, src/kvstore/kvstore_dist.h:197-203;
+server side src/kvstore/kvstore_dist_server.h:383-430, filename prefixed
+with the server's rank at :415).  `GeoPSServer` exposes the same surface:
+COMMAND {cmd: "set_profiler_params"|"profiler_start"|"profiler_stop"|
+"profiler_dump"}, with the dump path prefixed ``rank<k>_``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Profiler:
+    """Host-side Chrome-trace profiler with optional device trace capture.
+
+    Modes mirror the reference's MXSetProcessProfilerConfig /
+    MXDumpProcessProfile cycle: configure -> set_state(run) ->
+    scopes/events accumulate -> dump.
+    """
+
+    def __init__(self, filename: str = "profile.json",
+                 profile_all: bool = True,
+                 rank: Optional[int] = None):
+        self.filename = filename
+        self.profile_all = profile_all
+        self.rank = rank
+        self.running = False
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._device_trace_dir: Optional[str] = None
+
+    # ---- configuration (reference kSetProfilerParams payload) -------------
+    def set_config(self, filename: Optional[str] = None,
+                   profile_all: Optional[bool] = None,
+                   **_ignored) -> None:
+        if filename is not None:
+            self.filename = filename
+        if profile_all is not None:
+            self.profile_all = bool(profile_all)
+
+    def set_state(self, run: bool) -> None:
+        self.running = bool(run)
+
+    # ---- event recording ---------------------------------------------------
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def add_event(self, name: str, begin_us: float, end_us: float,
+                  category: str = "host", args: Optional[Dict] = None):
+        if not self.running:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": category, "ph": "X",
+                "ts": begin_us, "dur": end_us - begin_us,
+                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+                "args": args or {},
+            })
+
+    def instant(self, name: str, category: str = "host"):
+        if not self.running:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": category, "ph": "i", "s": "g",
+                "ts": self._now_us(), "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000,
+            })
+
+    @contextlib.contextmanager
+    def scope(self, name: str, category: str = "host"):
+        """Record a named duration; also annotates the XLA trace so the
+        scope shows up inside TensorBoard device profiles (the analogue of
+        engine ops carrying profiler names, kvstore_dist.h:654)."""
+        if not self.running:
+            yield
+            return
+        begin = self._now_us()
+        ann = None
+        try:
+            import jax.profiler as jp
+            ann = jp.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:
+            ann = None
+        try:
+            yield
+        finally:
+            if ann is not None:
+                try:
+                    ann.__exit__(None, None, None)
+                except Exception:
+                    pass
+            self.add_event(name, begin, self._now_us(), category)
+
+    # ---- device (XLA) traces ----------------------------------------------
+    def start_device_trace(self, logdir: str) -> None:
+        import jax.profiler as jp
+        self._device_trace_dir = logdir
+        jp.start_trace(logdir)
+
+    def stop_device_trace(self) -> None:
+        if self._device_trace_dir is None:
+            return
+        import jax.profiler as jp
+        jp.stop_trace()
+        self._device_trace_dir = None
+
+    # ---- output ------------------------------------------------------------
+    def _dump_path(self) -> str:
+        # reference prefixes the dump filename with the server's rank
+        # (kvstore_dist_server.h:415)
+        if self.rank is None:
+            return self.filename
+        d, b = os.path.split(self.filename)
+        return os.path.join(d, f"rank{self.rank}_{b}")
+
+    def dump(self, path: Optional[str] = None) -> str:
+        path = path or self._dump_path()
+        with self._lock:
+            events = list(self._events)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        return path
+
+    def aggregate_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-name {count,total_us,min_us,max_us,avg_us} — the reference's
+        AggregateStats table (src/profiler/aggregate_stats.cc)."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for e in self._events:
+                if e.get("ph") != "X":
+                    continue
+                s = out.setdefault(e["name"], {
+                    "count": 0, "total_us": 0.0,
+                    "min_us": float("inf"), "max_us": 0.0})
+                s["count"] += 1
+                s["total_us"] += e["dur"]
+                s["min_us"] = min(s["min_us"], e["dur"])
+                s["max_us"] = max(s["max_us"], e["dur"])
+        for s in out.values():
+            s["avg_us"] = s["total_us"] / max(s["count"], 1)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+# Process-global profiler, like the reference's Profiler::Get() singleton.
+_global: Optional[Profiler] = None
+_global_lock = threading.Lock()
+
+
+def get_profiler() -> Profiler:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = Profiler()
+        return _global
+
+
+@contextlib.contextmanager
+def profile_scope(name: str, category: str = "host"):
+    with get_profiler().scope(name, category):
+        yield

@@ -44,13 +44,20 @@ struct GxQueue {
   std::condition_variable cv;
   uint64_t next_seq = 0;
   bool closed = false;
+  int waiters = 0;                 // threads inside gx_queue_pop
+  std::condition_variable drain;   // signalled when a waiter leaves
 };
 
 void* gx_queue_create() { return new GxQueue(); }
 
+// Safe against concurrent poppers: closes the queue, then waits for every
+// thread inside gx_queue_pop to leave before freeing.
 void gx_queue_destroy(void* q) {
   auto* gq = static_cast<GxQueue*>(q);
   std::unique_lock<std::mutex> lk(gq->mu);
+  gq->closed = true;
+  gq->cv.notify_all();
+  gq->drain.wait(lk, [&] { return gq->waiters == 0; });
   while (!gq->heap.empty()) {
     delete gq->heap.top();
     gq->heap.pop();
@@ -81,6 +88,11 @@ int64_t gx_queue_pop(void* q, uint8_t* buf, int64_t buf_len,
                      int64_t* out_required) {
   auto* gq = static_cast<GxQueue*>(q);
   std::unique_lock<std::mutex> lk(gq->mu);
+  gq->waiters++;
+  struct Leave {
+    GxQueue* g;
+    ~Leave() { if (--g->waiters == 0) g->drain.notify_all(); }
+  } leave{gq};
   auto ready = [&] { return !gq->heap.empty() || gq->closed; };
   if (timeout_ms < 0) {
     gq->cv.wait(lk, ready);
@@ -88,7 +100,7 @@ int64_t gx_queue_pop(void* q, uint8_t* buf, int64_t buf_len,
                               ready)) {
     return -2;
   }
-  if (gq->heap.empty()) return -1;  // closed
+  if (gq->heap.empty()) return -1;  // closed and drained
   GxMessage* msg = gq->heap.top();
   int64_t n = static_cast<int64_t>(msg->payload.size());
   if (out_required) *out_required = n;

@@ -1,0 +1,100 @@
+"""Profiler subsystem tests.
+
+Parity targets: Chrome-trace dump + aggregate stats (reference
+src/profiler/profiler.h:256, aggregate_stats.cc) and worker-driven remote
+server profiling via kvstore commands (kvstore_dist.h:197-203,
+kvstore_dist_server.h:383-430 — dump filename rank-prefixed at :415).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from geomx_tpu.service import GeoPSClient, GeoPSServer
+from geomx_tpu.utils.profiler import Profiler, get_profiler, profile_scope
+
+
+def test_scope_recording_and_chrome_dump(tmp_path):
+    p = Profiler(filename=str(tmp_path / "trace.json"))
+    p.set_state(True)
+    with p.scope("step"):
+        with p.scope("fwd"):
+            time.sleep(0.002)
+        with p.scope("bwd"):
+            time.sleep(0.001)
+    path = p.dump()
+    with open(path) as f:
+        doc = json.load(f)
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert set(names) == {"step", "fwd", "bwd"}
+    # complete events with microsecond durations
+    by = {e["name"]: e for e in doc["traceEvents"]}
+    assert all(e["ph"] == "X" for e in doc["traceEvents"])
+    assert by["fwd"]["dur"] >= 1000  # slept 2ms
+    assert by["step"]["dur"] >= by["fwd"]["dur"] + by["bwd"]["dur"]
+
+
+def test_disabled_profiler_records_nothing(tmp_path):
+    p = Profiler(filename=str(tmp_path / "t.json"))
+    with p.scope("ignored"):
+        pass
+    p.instant("also-ignored")
+    assert p.aggregate_stats() == {}
+
+
+def test_aggregate_stats():
+    p = Profiler()
+    p.set_state(True)
+    for _ in range(5):
+        with p.scope("op"):
+            pass
+    stats = p.aggregate_stats()
+    assert stats["op"]["count"] == 5
+    assert stats["op"]["min_us"] <= stats["op"]["avg_us"] <= stats["op"]["max_us"]
+    assert np.isclose(stats["op"]["total_us"],
+                      stats["op"]["avg_us"] * 5, rtol=1e-6)
+
+
+def test_rank_prefixed_dump_path(tmp_path):
+    p = Profiler(filename=str(tmp_path / "profile.json"), rank=3)
+    p.set_state(True)
+    with p.scope("x"):
+        pass
+    path = p.dump()
+    assert os.path.basename(path) == "rank3_profile.json"
+
+
+def test_global_profiler_singleton():
+    assert get_profiler() is get_profiler()
+    get_profiler().set_state(True)
+    with profile_scope("g"):
+        pass
+    assert "g" in get_profiler().aggregate_stats()
+    get_profiler().set_state(False)
+    get_profiler().reset()
+
+
+def test_remote_profiler_control(tmp_path):
+    """Worker configures, starts, and dumps the profiler on a remote PS
+    server — kSetProfilerParams parity."""
+    server = GeoPSServer(num_workers=1, mode="sync", rank=1).start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    try:
+        c.set_profiler_params(filename=str(tmp_path / "server.json"))
+        c.profiler_start()
+        c.init("w", np.zeros(64, np.float32))
+        c.push("w", np.ones(64, np.float32))
+        np.testing.assert_allclose(c.pull("w"), 1.0)
+        c.profiler_stop()
+        path = c.profiler_dump()
+        assert os.path.basename(path) == "rank1_server.json"
+        with open(path) as f:
+            doc = json.load(f)
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert any(n.startswith("ServerPush:") for n in names)
+    finally:
+        c.stop_server()
+        c.close()
+        server.join(5)

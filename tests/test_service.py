@@ -168,8 +168,14 @@ def test_priority_ordering_on_the_wire():
     c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
     for i in range(4):
         c.init(f"layer{i}", np.zeros(8, np.float32))
-    # stall the sender so all pushes queue, then release
+    # stall the sender so all pushes queue, then release.  The sender pops
+    # one message before blocking on the write lock, so feed it a
+    # sacrificial max-priority heartbeat first; the 4 data pushes then all
+    # sit in the queue together and must leave in priority order.
+    from geomx_tpu.service.protocol import Msg, MsgType
     with c._wlock:
+        c._submit(Msg(MsgType.HEARTBEAT), priority=10)
+        time.sleep(0.05)
         rids = [c.push_async(f"layer{i}", np.ones(8, np.float32),
                              priority=-i)
                 for i in (3, 1, 2, 0)]
