@@ -1,0 +1,158 @@
+"""Process-separated HiPS training via the host-side PS service.
+
+The reference's launch model runs one OS process per node role, configured
+entirely by environment variables (scripts/cpu/run_vanilla_hips.sh:8-148;
+roles in 3rdparty/ps-lite/include/ps/internal/message.h:74; non-worker
+processes become blocking servers inside ``import mxnet``,
+python/mxnet/kvstore_server.py:30-89).  This demo reproduces that shape
+with geomx_tpu's GeoPSServer/GeoPSClient:
+
+  GEOMX_ROLE=global_server   — the global PS tier (one process)
+  GEOMX_ROLE=server          — a party's local PS; relays to the global tier
+  GEOMX_ROLE=worker          — trains, push/pull against its party's server
+
+Topology env (reference DMLC_* analogues):
+  GEOMX_NUM_PARTIES, GEOMX_WORKERS_PER_PARTY — cluster shape
+  GEOMX_PARTY_ID, GEOMX_WORKER_ID            — this process's coordinates
+  GEOMX_PS_GLOBAL_PORT, GEOMX_PS_PORT        — listen/connect ports
+  GEOMX_SYNC_MODE  fsa|mixed                 — maps to server sync/async
+  GEOMX_COMPRESSION e.g. "bsc,0.01" | "fp16" — cross-party hop compression
+  PS_RESEND/PS_RESEND_TIMEOUT/PS_DROP_MSG    — reliability/fault injection
+
+Run scripts/cpu/run_dist_ps.sh for the full multi-process topology on
+localhost (the reference's pseudo-distributed mode).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def env(name, default=None, cast=str):
+    v = os.environ.get(name)
+    return cast(v) if v not in (None, "") else default
+
+
+ROLE = env("GEOMX_ROLE", "worker")
+NUM_PARTIES = env("GEOMX_NUM_PARTIES", 2, int)
+WORKERS_PER_PARTY = env("GEOMX_WORKERS_PER_PARTY", 2, int)
+PARTY_ID = env("GEOMX_PARTY_ID", 0, int)
+WORKER_ID = env("GEOMX_WORKER_ID", 0, int)
+GLOBAL_PORT = env("GEOMX_PS_GLOBAL_PORT", 19700, int)
+LOCAL_PORT = env("GEOMX_PS_PORT", 19800, int)  # + party_id
+SYNC = env("GEOMX_SYNC_MODE", "fsa")
+COMPRESSION = env("GEOMX_COMPRESSION", None)
+EPOCHS = env("GEOMX_EPOCHS", 3, int)
+BATCH = env("GEOMX_BATCH", 64, int)
+LR = env("GEOMX_LR", 0.1, float)
+MODE = "sync" if SYNC == "fsa" else "async"
+
+
+def run_global_server():
+    from geomx_tpu.service import GeoPSServer
+    srv = GeoPSServer(port=GLOBAL_PORT, num_workers=NUM_PARTIES,
+                      mode=MODE, rank=0).start()
+    print(f"[global_server] listening on {GLOBAL_PORT} "
+          f"({NUM_PARTIES} parties, {MODE})", flush=True)
+    srv.join()
+    print("[global_server] stopped", flush=True)
+
+
+def run_local_server():
+    from geomx_tpu.service import GeoPSServer
+    port = LOCAL_PORT + PARTY_ID
+    srv = GeoPSServer(port=port, num_workers=WORKERS_PER_PARTY, mode=MODE,
+                      global_addr=("127.0.0.1", GLOBAL_PORT),
+                      compression=COMPRESSION, rank=1 + PARTY_ID,
+                      global_sender_id=1000 + PARTY_ID).start()
+    print(f"[server p{PARTY_ID}] listening on {port} "
+          f"({WORKERS_PER_PARTY} workers, compression={COMPRESSION})",
+          flush=True)
+    srv.join()
+    print(f"[server p{PARTY_ID}] stopped", flush=True)
+
+
+def make_data(seed, n=2048, d=64, classes=10):
+    """Per-worker shard of a fixed synthetic classification problem — the
+    SplitSampler semantics (reference examples/utils.py:10-22): same
+    dataset everywhere, disjoint part per global worker rank."""
+    rng = np.random.RandomState(0)
+    w_true = rng.normal(size=(d, classes)).astype(np.float32)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.argmax(x @ w_true + 0.1 * rng.normal(size=(n, classes)), axis=1)
+    total = NUM_PARTIES * WORKERS_PER_PARTY
+    rank = PARTY_ID * WORKERS_PER_PARTY + WORKER_ID
+    part = n // total
+    sl = slice(rank * part, (rank + 1) * part)
+    return x[sl], y[sl].astype(np.int32), x[:512], y[:512].astype(np.int32)
+
+
+def run_worker():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from geomx_tpu.service import GeoPSClient
+
+    port = LOCAL_PORT + PARTY_ID
+    rank = PARTY_ID * WORKERS_PER_PARTY + WORKER_ID
+    resend = env("PS_RESEND", 0, int)
+    c = GeoPSClient(("127.0.0.1", port), sender_id=WORKER_ID,
+                    resend_timeout_ms=1000 if resend else None)
+
+    d, classes = 64, 10
+    x, y, xt, yt = make_data(rank)
+    rng = np.random.RandomState(0)  # identical init on every worker
+    params = {"w": (rng.normal(size=(d, classes)) * 0.01).astype(np.float32),
+              "b": np.zeros((classes,), np.float32)}
+    for k, v in params.items():
+        c.init(k, v)
+
+    # the master worker configures the global-tier optimizer, like the
+    # reference's DMLC_ROLE_MASTER_WORKER (examples/cnn.py:92-96)
+    if rank == 0:
+        c.set_optimizer("sgd", learning_rate=LR)
+    c.barrier()
+
+    @jax.jit
+    def grads(params, xb, yb):
+        def loss_fn(p):
+            logits = xb @ p["w"] + p["b"]
+            lse = jax.scipy.special.logsumexp(logits, axis=1)
+            ll = logits[jnp.arange(xb.shape[0]), yb] - lse
+            return -ll.mean()
+        return jax.grad(loss_fn)(params)
+
+    steps = len(x) // BATCH
+    for ep in range(EPOCHS):
+        perm = np.random.RandomState(ep).permutation(len(x))
+        for s in range(steps):
+            idx = perm[s * BATCH:(s + 1) * BATCH]
+            g = grads(params, jnp.asarray(x[idx]), jnp.asarray(y[idx]))
+            # P3 discipline: front-layer keys get higher priority
+            for pr, k in enumerate(sorted(params)):
+                c.push(k, np.asarray(g[k]), priority=-pr)
+            for k in sorted(params):
+                params[k] = c.pull(k)
+        logits = x @ params["w"] + params["b"]
+        acc = float((np.argmax(logits, 1) == y).mean())
+        t_logits = xt @ params["w"] + params["b"]
+        t_acc = float((np.argmax(t_logits, 1) == yt).mean())
+        print(f"[worker p{PARTY_ID}w{WORKER_ID}] epoch {ep} "
+              f"train_acc {acc:.3f} test_acc {t_acc:.3f}", flush=True)
+
+    c.barrier()
+    # every worker sends kStopServer; the local server stops once all its
+    # workers have, then forwards the stop up (reference
+    # kvstore_dist_server.h:289-301 counts stop commands per tier)
+    c.stop_server()
+    c.close()
+
+
+if __name__ == "__main__":
+    {"global_server": run_global_server,
+     "server": run_local_server,
+     "worker": run_worker}[ROLE]()

@@ -105,7 +105,8 @@ class NativePriorityQueue:
             return buf.raw[:n], int(prio.value)
 
     def close(self) -> None:
-        self._lib.gx_queue_close(self._q)
+        if self._q is not None:
+            self._lib.gx_queue_close(self._q)
 
     def destroy(self) -> None:
         """Free the native queue.  Only call once no consumer thread can
@@ -116,6 +117,8 @@ class NativePriorityQueue:
             self._lib.gx_queue_destroy(q)
 
     def __len__(self) -> int:
+        if self._q is None:
+            return 0
         return int(self._lib.gx_queue_size(self._q))
 
     def __del__(self):

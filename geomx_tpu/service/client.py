@@ -99,11 +99,13 @@ class GeoPSClient:
             except OSError:
                 msg = None
             if msg is None:
-                # connection closed: release every waiter
+                # connection closed: release every waiter.  Entries stay in
+                # the dict — wait() pops them — so a reply that landed just
+                # before the close is still consumable (reply set + event
+                # fired), instead of being wiped into a KeyError.
                 with self._plock:
                     for p in self._pending.values():
                         p.event.set()
-                    self._pending = {}
                 return
             rid = msg.meta.get("rid")
             with self._plock:
@@ -249,6 +251,8 @@ class GeoPSClient:
             pass
 
     def close(self) -> None:
+        if self._closed:
+            return
         self._closed = True
         self._sendq.close()
         try:

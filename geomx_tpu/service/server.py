@@ -95,6 +95,9 @@ class GeoPSServer:
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", port))
         self._srv.listen(64)
+        # a blocked accept() is not reliably woken by close() on Linux, so
+        # poll with a short timeout and re-check _running
+        self._srv.settimeout(0.2)
         self.port = self._srv.getsockname()[1]
         self._running = True
         self._accept_thread = threading.Thread(target=self._accept_loop,
@@ -130,8 +133,11 @@ class GeoPSServer:
         while self._running:
             try:
                 conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
+            conn.settimeout(None)  # per-connection sockets block normally
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -178,6 +184,24 @@ class GeoPSServer:
                     if self._compressor is not None:
                         self._comp_state[msg.key] = \
                             self._compressor.init_leaf_state(msg.array)
+                    # propagate upward so the global tier owns every key
+                    # (the reference inits global store on first push-
+                    # through, kvstore_dist_server.h:1241-1273)
+                    if self._global_sock is not None:
+                        fwd = Msg(MsgType.INIT, key=msg.key,
+                                  meta={"reliable": True}, array=msg.array)
+                        fwd.sender = self._global_sender_id
+                        send_frame(self._global_sock, fwd)
+                        rep = recv_frame(self._global_sock)
+                        if rep is None or rep.type == MsgType.ERROR:
+                            # undo the local registration so a retried
+                            # INIT re-forwards; surface the failure
+                            del self._store[msg.key]
+                            self._opt_state.pop(msg.key, None)
+                            if self._compressor is not None:
+                                self._comp_state.pop(msg.key, None)
+                            raise RuntimeError(
+                                f"global INIT failed for {msg.key}: {rep}")
             self._reply(conn, msg, Msg(MsgType.ACK, key=msg.key))
         elif t == MsgType.PUSH:
             self._handle_push(conn, msg)
@@ -212,13 +236,24 @@ class GeoPSServer:
         cmd = msg.meta.get("cmd")
         if cmd == "set_optimizer":
             # reference pickles the optimizer to the server (kController);
-            # here only a named optax optimizer + kwargs travel the wire
-            from geomx_tpu.optim import get_optimizer
-            self._tx = get_optimizer(msg.meta["name"],
-                                     **msg.meta.get("kwargs", {}))
-            with self._lock:
-                for k, st in self._store.items():
-                    self._opt_state[k] = self._tx.init(st.value)
+            # here only a named optax optimizer + kwargs travel the wire.
+            # A local-tier server forwards it up: the optimizer runs on the
+            # GLOBAL tier (kvstore_dist_server.h:512-515 — python updater
+            # executes on global servers; local tier is pure aggregation).
+            if self._global_sock is not None:
+                with self._lock:
+                    fwd = Msg(MsgType.COMMAND,
+                              meta=dict(msg.meta, reliable=True))
+                    fwd.sender = self._global_sender_id
+                    send_frame(self._global_sock, fwd)
+                    recv_frame(self._global_sock)
+            else:
+                from geomx_tpu.optim import get_optimizer
+                self._tx = get_optimizer(msg.meta["name"],
+                                         **msg.meta.get("kwargs", {}))
+                with self._lock:
+                    for k, st in self._store.items():
+                        self._opt_state[k] = self._tx.init(st.value)
         elif cmd == "set_gradient_compression":
             from geomx_tpu.compression import get_compressor
             self._compressor = get_compressor(msg.meta["spec"])
@@ -407,10 +442,12 @@ class GeoPSServer:
             need = st.pushed.get(msg.sender, 0)
             if self.mode == "sync" and st.round < need:
                 rid = msg.meta.get("rid")
-                # a resent PULL with the same rid must not queue twice —
-                # the original entry will answer it (one reply per request)
-                if rid is None or all(w[1] != rid
-                                      for w in st.waiting_pulls):
+                # a resent PULL (same connection, same rid) must not queue
+                # twice — the original entry will answer it; different
+                # connections may legitimately collide on rid
+                if rid is None or all(
+                        not (w[0] is conn and w[1] == rid)
+                        for w in st.waiting_pulls):
                     st.waiting_pulls.append((conn, rid, need))
                 return
             self._reply(conn, msg, Msg(MsgType.PULL_REPLY, key=msg.key,

@@ -26,6 +26,22 @@ import time
 from typing import Any, Dict, List, Optional
 
 
+_ANN_CLS: Any = False  # False = unresolved; None = jax unavailable
+
+
+def _trace_annotation_cls():
+    """jax.profiler.TraceAnnotation, resolved once (failed imports are not
+    cached by Python, so retrying per scope would tax the push hot path)."""
+    global _ANN_CLS
+    if _ANN_CLS is False:
+        try:
+            import jax.profiler as jp
+            _ANN_CLS = jp.TraceAnnotation
+        except Exception:
+            _ANN_CLS = None
+    return _ANN_CLS
+
+
 class Profiler:
     """Host-side Chrome-trace profiler with optional device trace capture.
 
@@ -93,13 +109,14 @@ class Profiler:
             yield
             return
         begin = self._now_us()
+        ann_cls = _trace_annotation_cls()
         ann = None
-        try:
-            import jax.profiler as jp
-            ann = jp.TraceAnnotation(name)
-            ann.__enter__()
-        except Exception:
-            ann = None
+        if ann_cls is not None:
+            try:
+                ann = ann_cls(name)
+                ann.__enter__()
+            except Exception:
+                ann = None
         try:
             yield
         finally:

@@ -1,0 +1,28 @@
+# Shared launch-script plumbing (analogue of the env-var preamble every
+# reference scripts/cpu/*.sh repeats, scripts/cpu/run_vanilla_hips.sh:8-30).
+#
+# The reference simulates a 2-party geo-distributed cluster with 12
+# processes on 127.0.0.1; the TPU-native rebuild expresses the same
+# topology as a 2-level device mesh in ONE SPMD program, so "pseudo-
+# distributed" here means a virtual 8-device CPU mesh (2 parties x 4
+# workers by default).  run_dist_ps.sh is the exception: it really forks
+# one OS process per node role, like the reference.
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+
+: "${GEOMX_NUM_PARTIES:=2}"
+: "${GEOMX_WORKERS_PER_PARTY:=4}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+
+run_on_cpu_mesh() {
+  # pseudo-distributed: N virtual devices on the host CPU
+  local n=$((GEOMX_NUM_PARTIES * GEOMX_WORKERS_PER_PARTY))
+  XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${n}" \
+    python "$@" -c
+}
+
+run_on_tpu() {
+  # real accelerator; topology should fit jax.device_count()
+  python "$@"
+}

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Bi-Sparse gradient compression: top-k sparsification of both the push
+# and the pull across the cross-party (DCN) tier.
+# Reference analogue: scripts/cpu/run_bisparse_compression.sh
+# (README.md:22, gradient_compression.cc:191-336).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+run_on_cpu_mesh examples/cnn_bsc.py -d synthetic -ep 2 "$@"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# DGT (Differential Gradient Transmission): contribution-aware deferred
+# aggregation — the top DMLC_K fraction of gradient blocks syncs on the
+# critical path, the rest is delivered lazily.
+# Reference analogue: scripts/cpu/run_dgt.sh (ENABLE_DGT=2, DMLC_K=0.8,
+# DMLC_UDP_CHANNEL_NUM=3, ADAPTIVE_K_FLAG=1; kv_app.h:1088-1196).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_ENABLE_DGT=2
+export GEOMX_DGT_K="${GEOMX_DGT_K:-0.8}"
+export GEOMX_UDP_CHANNEL_NUM="${GEOMX_UDP_CHANNEL_NUM:-3}"
+export GEOMX_ADAPTIVE_K="${GEOMX_ADAPTIVE_K:-1}"
+run_on_cpu_mesh examples/cnn.py -d synthetic -ep 2 "$@"

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# The REAL multi-process HiPS topology on localhost: one OS process per
+# node role, exactly the reference's pseudo-distributed launch model
+# (scripts/cpu/run_vanilla_hips.sh runs global scheduler + global
+# servers + per-party {scheduler, server, workers} = 12 processes; ours
+# is 1 global server + P local servers + P*W workers — scheduling is
+# folded into the servers, so 7 processes for the default 2x2).
+#
+# Env knobs: GEOMX_NUM_PARTIES, GEOMX_WORKERS_PER_PARTY, GEOMX_SYNC_MODE
+# (fsa|mixed), GEOMX_COMPRESSION (e.g. "bsc,0.01" / "fp16"),
+# PS_RESEND/PS_RESEND_TIMEOUT/PS_DROP_MSG (reliability/fault injection).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+: "${GEOMX_NUM_PARTIES:=2}"
+: "${GEOMX_WORKERS_PER_PARTY:=2}"
+: "${GEOMX_PS_GLOBAL_PORT:=19700}"
+: "${GEOMX_PS_PORT:=19800}"
+: "${GEOMX_EPOCHS:=3}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY \
+       GEOMX_PS_GLOBAL_PORT GEOMX_PS_PORT GEOMX_EPOCHS
+
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+GEOMX_ROLE=global_server python examples/dist_ps.py &
+pids+=($!)
+sleep 1
+
+for ((p = 0; p < GEOMX_NUM_PARTIES; p++)); do
+  GEOMX_ROLE=server GEOMX_PARTY_ID=$p python examples/dist_ps.py &
+  pids+=($!)
+done
+sleep 1
+
+wpids=()
+for ((p = 0; p < GEOMX_NUM_PARTIES; p++)); do
+  for ((w = 0; w < GEOMX_WORKERS_PER_PARTY; w++)); do
+    GEOMX_ROLE=worker GEOMX_PARTY_ID=$p GEOMX_WORKER_ID=$w \
+      python examples/dist_ps.py &
+    wpids+=($!)
+  done
+done
+
+status=0
+for pid in "${wpids[@]}"; do wait "$pid" || status=1; done
+# servers exit on their own after every worker sends kStopServer
+for pid in "${pids[@]}"; do wait "$pid" 2>/dev/null || true; done
+pids=()
+exit $status

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# FP16 low-precision transmission: fp32 compute, fp16 cross-party hop.
+# Reference analogue: scripts/cpu/run_fp16.sh (README.md:23).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+run_on_cpu_mesh examples/cnn_fp16.py -d synthetic -ep 2 "$@"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# HFA (Hierarchical Frequency Aggregation): K1 local steps per local
+# sync, K2 local syncs per global sync, milestone-delta accumulation.
+# Reference analogue: scripts/cpu/run_hfa_sync.sh (K1=20 K2=10,
+# kvstore_dist_server.h:988-1017).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_HFA_K1="${GEOMX_HFA_K1:-20}"
+export GEOMX_HFA_K2="${GEOMX_HFA_K2:-10}"
+run_on_cpu_mesh examples/cnn_hfa.py -d synthetic -ep 2 "$@"

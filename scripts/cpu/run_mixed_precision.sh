@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# MPQ (Mixed-Precision Quantization): small tensors travel fp16, large
+# tensors Bi-Sparse, split at GEOMX_SIZE_LOWER_BOUND elements.
+# Reference analogue: scripts/cpu/run_mixed_precision.sh (README.md:24,
+# examples/cnn_mpq.py:86-126).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_SIZE_LOWER_BOUND="${GEOMX_SIZE_LOWER_BOUND:-200000}"
+run_on_cpu_mesh examples/cnn_mpq.py -d synthetic -ep 2 "$@"

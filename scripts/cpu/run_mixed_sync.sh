@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# MixedSync: synchronous intra-party tier, asynchronous global tier;
+# pass --dcasgd for DCASGD delay compensation.
+# Reference analogue: scripts/cpu/run_mixed_sync.sh (README.md:36-39).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_SYNC_MODE=mixed
+run_on_cpu_mesh examples/cnn.py -d synthetic -ep 2 -ms "$@"

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# MultiGPS: multiple global parameter servers — big tensors sharded by
+# key-range across the global-server axis, small ones hashed.
+# Reference analogue: scripts/cpu/run_multi_gps.sh (README.md:28,
+# kvstore_dist_server.h:1786-1826); TPU-native form = sharded optimizer
+# state over the mesh (geomx_tpu/parallel/multigps.py).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_MULTI_GPS=1
+export GEOMX_BIGARRAY_BOUND="${GEOMX_BIGARRAY_BOUND:-1000}"
+run_on_cpu_mesh examples/cnn.py -d synthetic -ep 2 "$@"

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# P3 (Priority-based Parameter Propagation): layer-priority-ordered
+# chunked transfers.  The priority queue lives on the host-side PS path,
+# so this scenario runs the REAL multi-process PS topology where each
+# worker pushes with priority=-layer_index (examples/dist_ps.py).
+# Reference analogue: scripts/cpu/run_p3.sh (ENABLE_P3=1,
+# threadsafe_queue.h:50-58, kvstore_dist.h:835-872).
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_ENABLE_P3=1
+exec "$(dirname "$0")/run_dist_ps.sh" "$@"

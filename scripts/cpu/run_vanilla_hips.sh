@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Vanilla HiPS: fully-synchronous hierarchical data parallelism (FSA),
+# 2 parties x 4 workers on a virtual CPU mesh.
+# Reference analogue: scripts/cpu/run_vanilla_hips.sh (12 processes on
+# 127.0.0.1); here the same 2-tier topology is one SPMD program.
+set -euo pipefail
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_SYNC_MODE=fsa
+run_on_cpu_mesh examples/cnn.py -d synthetic -ep 2 "$@"

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Bi-Sparse gradient compression: top-k sparsification of both the push
+# and the pull across the cross-party (DCN) tier.
+# Reference analogue: scripts/cpu/run_bisparse_compression.sh
+# (README.md:22, gradient_compression.cc:191-336).
+set -euo pipefail
+GEOMX_NUM_PARTIES="${GEOMX_NUM_PARTIES:-1}"
+GEOMX_WORKERS_PER_PARTY="${GEOMX_WORKERS_PER_PARTY:-1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+source "$(dirname "$0")/../common.sh"
+
+run_on_tpu examples/cnn_bsc.py -d synthetic -ep 2 "$@"

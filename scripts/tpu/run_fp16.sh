@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# FP16 low-precision transmission: fp32 compute, fp16 cross-party hop.
+# Reference analogue: scripts/cpu/run_fp16.sh (README.md:23).
+set -euo pipefail
+GEOMX_NUM_PARTIES="${GEOMX_NUM_PARTIES:-1}"
+GEOMX_WORKERS_PER_PARTY="${GEOMX_WORKERS_PER_PARTY:-1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+source "$(dirname "$0")/../common.sh"
+
+run_on_tpu examples/cnn_fp16.py -d synthetic -ep 2 "$@"

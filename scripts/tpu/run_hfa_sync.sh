@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# HFA (Hierarchical Frequency Aggregation): K1 local steps per local
+# sync, K2 local syncs per global sync, milestone-delta accumulation.
+# Reference analogue: scripts/cpu/run_hfa_sync.sh (K1=20 K2=10,
+# kvstore_dist_server.h:988-1017).
+set -euo pipefail
+GEOMX_NUM_PARTIES="${GEOMX_NUM_PARTIES:-1}"
+GEOMX_WORKERS_PER_PARTY="${GEOMX_WORKERS_PER_PARTY:-1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_HFA_K1="${GEOMX_HFA_K1:-20}"
+export GEOMX_HFA_K2="${GEOMX_HFA_K2:-10}"
+run_on_tpu examples/cnn_hfa.py -d synthetic -ep 2 "$@"

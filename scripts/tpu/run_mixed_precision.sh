@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# MPQ (Mixed-Precision Quantization): small tensors travel fp16, large
+# tensors Bi-Sparse, split at GEOMX_SIZE_LOWER_BOUND elements.
+# Reference analogue: scripts/cpu/run_mixed_precision.sh (README.md:24,
+# examples/cnn_mpq.py:86-126).
+set -euo pipefail
+GEOMX_NUM_PARTIES="${GEOMX_NUM_PARTIES:-1}"
+GEOMX_WORKERS_PER_PARTY="${GEOMX_WORKERS_PER_PARTY:-1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_SIZE_LOWER_BOUND="${GEOMX_SIZE_LOWER_BOUND:-200000}"
+run_on_tpu examples/cnn_mpq.py -d synthetic -ep 2 "$@"

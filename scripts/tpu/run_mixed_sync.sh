@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# MixedSync: synchronous intra-party tier, asynchronous global tier;
+# pass --dcasgd for DCASGD delay compensation.
+# Reference analogue: scripts/cpu/run_mixed_sync.sh (README.md:36-39).
+set -euo pipefail
+GEOMX_NUM_PARTIES="${GEOMX_NUM_PARTIES:-1}"
+GEOMX_WORKERS_PER_PARTY="${GEOMX_WORKERS_PER_PARTY:-1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_SYNC_MODE=mixed
+run_on_tpu examples/cnn.py -d synthetic -ep 2 -ms "$@"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# TSEngine: adaptive communication-overlay scheduling for the WAN tier.
+# Reference analogue: scripts/cpu/run_tsengine.sh (ENABLE_INTER_TS /
+# ENABLE_INTRA_TS, MAX_GREED_RATE_TS=0.9; van.cc:1192-1551).
+# On the SPMD path XLA already schedules collectives; the TSEngine
+# scheduler proper (geomx_tpu/transport/tsengine.py + native) drives the
+# host-side PS dissemination.
+set -euo pipefail
+GEOMX_NUM_PARTIES="${GEOMX_NUM_PARTIES:-1}"
+GEOMX_WORKERS_PER_PARTY="${GEOMX_WORKERS_PER_PARTY:-1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_ENABLE_INTER_TS=1
+export GEOMX_ENABLE_INTRA_TS=1
+export GEOMX_MAX_GREED_RATE="${GEOMX_MAX_GREED_RATE:-0.9}"
+run_on_tpu examples/cnn.py -d synthetic -ep 2 "$@"

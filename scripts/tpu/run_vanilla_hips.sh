@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Vanilla HiPS: fully-synchronous hierarchical data parallelism (FSA),
+# single real TPU chip (1x1 topology); scale GEOMX_* up on a pod.
+# Reference analogue: scripts/cpu/run_vanilla_hips.sh (12 processes on
+# 127.0.0.1); here the same 2-tier topology is one SPMD program.
+set -euo pipefail
+GEOMX_NUM_PARTIES="${GEOMX_NUM_PARTIES:-1}"
+GEOMX_WORKERS_PER_PARTY="${GEOMX_WORKERS_PER_PARTY:-1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+source "$(dirname "$0")/../common.sh"
+
+export GEOMX_SYNC_MODE=fsa
+run_on_tpu examples/cnn.py -d synthetic -ep 2 "$@"
