@@ -1,0 +1,20 @@
+# Development / pseudo-distributed simulation image (CPU backend).
+# Reference analogue: docker/build_on_cpu.dockerfile — the reference builds
+# its MXNet fork from source here; we only need jax[cpu] + the package.
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/geomx_tpu
+COPY . .
+
+RUN pip install --no-cache-dir "jax[cpu]" flax optax numpy pytest && \
+    make -C native
+
+ENV PYTHONPATH=/opt/geomx_tpu \
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+CMD ["bash", "scripts/cpu/run_vanilla_hips.sh"]

@@ -1,0 +1,21 @@
+# TPU-VM image. Reference analogue: docker/build_on_gpu.dockerfile (CUDA
+# build); on TPU the accelerator runtime ships with jax[tpu], so the image
+# is just the package over the TPU-enabled jaxlib. Run on a TPU VM with
+# the accelerator devices exposed (--privileged or the TPU device plugin).
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/geomx_tpu
+COPY . .
+
+RUN pip install --no-cache-dir "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        flax optax numpy pytest && \
+    make -C native
+
+ENV PYTHONPATH=/opt/geomx_tpu
+
+CMD ["bash", "scripts/tpu/run_vanilla_hips.sh"]

@@ -43,6 +43,11 @@ PARTY_ID = env("GEOMX_PARTY_ID", 0, int)
 WORKER_ID = env("GEOMX_WORKER_ID", 0, int)
 GLOBAL_PORT = env("GEOMX_PS_GLOBAL_PORT", 19700, int)
 LOCAL_PORT = env("GEOMX_PS_PORT", 19800, int)  # + party_id
+# multi-host: where the tiers live (reference DMLC_PS_GLOBAL_ROOT_URI /
+# DMLC_PS_ROOT_URI; localhost for the pseudo-distributed mode)
+GLOBAL_HOST = (env("GEOMX_PS_GLOBAL_HOST")
+               or env("DMLC_PS_GLOBAL_ROOT_URI") or "127.0.0.1")
+LOCAL_HOST = env("GEOMX_PS_HOST") or env("DMLC_PS_ROOT_URI") or "127.0.0.1"
 SYNC = env("GEOMX_SYNC_MODE", "fsa")
 COMPRESSION = env("GEOMX_COMPRESSION", None)
 EPOCHS = env("GEOMX_EPOCHS", 3, int)
@@ -65,7 +70,7 @@ def run_local_server():
     from geomx_tpu.service import GeoPSServer
     port = LOCAL_PORT + PARTY_ID
     srv = GeoPSServer(port=port, num_workers=WORKERS_PER_PARTY, mode=MODE,
-                      global_addr=("127.0.0.1", GLOBAL_PORT),
+                      global_addr=(GLOBAL_HOST, GLOBAL_PORT),
                       compression=COMPRESSION, rank=1 + PARTY_ID,
                       global_sender_id=1000 + PARTY_ID).start()
     print(f"[server p{PARTY_ID}] listening on {port} "
@@ -75,7 +80,7 @@ def run_local_server():
     print(f"[server p{PARTY_ID}] stopped", flush=True)
 
 
-def make_data(seed, n=2048, d=64, classes=10):
+def make_data(n=2048, d=64, classes=10):
     """Per-worker shard of a fixed synthetic classification problem — the
     SplitSampler semantics (reference examples/utils.py:10-22): same
     dataset everywhere, disjoint part per global worker rank."""
@@ -98,22 +103,26 @@ def run_worker():
     from geomx_tpu.service import GeoPSClient
 
     port = LOCAL_PORT + PARTY_ID
-    rank = PARTY_ID * WORKERS_PER_PARTY + WORKER_ID
     resend = env("PS_RESEND", 0, int)
-    c = GeoPSClient(("127.0.0.1", port), sender_id=WORKER_ID,
+    c = GeoPSClient((LOCAL_HOST, port), sender_id=WORKER_ID,
                     resend_timeout_ms=1000 if resend else None)
 
     d, classes = 64, 10
-    x, y, xt, yt = make_data(rank)
+    x, y, xt, yt = make_data()
     rng = np.random.RandomState(0)  # identical init on every worker
     params = {"w": (rng.normal(size=(d, classes)) * 0.01).astype(np.float32),
               "b": np.zeros((classes,), np.float32)}
     for k, v in params.items():
         c.init(k, v)
 
-    # the master worker configures the global-tier optimizer, like the
-    # reference's DMLC_ROLE_MASTER_WORKER (examples/cnn.py:92-96)
-    if rank == 0:
+    # each party's lead worker configures the global-tier optimizer (the
+    # reference's DMLC_ROLE_MASTER_WORKER role, examples/cnn.py:92-96).
+    # Every party sends it (idempotent server-side) because the barrier is
+    # party-local: with only rank 0 configuring, another party's first
+    # async-mode push could reach the global tier before the optimizer
+    # command and be applied as a raw overwrite.  Within a party, FIFO
+    # ordering on the relay socket puts the command before any push.
+    if WORKER_ID == 0:
         c.set_optimizer("sgd", learning_rate=LR)
     c.barrier()
 

@@ -5,9 +5,11 @@ The reference's demo workloads are Gluon CNNs on MNIST/FashionMNIST/CIFAR10
 """
 
 from geomx_tpu.models.cnn import GeoCNN
+from geomx_tpu.models.mlp import MLP, AlexNet
 from geomx_tpu.models.resnet import ResNet, ResNet20, ResNet32, ResNet56, ResNet18
 
-__all__ = ["GeoCNN", "ResNet", "ResNet20", "ResNet32", "ResNet56", "ResNet18",
+__all__ = ["GeoCNN", "MLP", "AlexNet",
+           "ResNet", "ResNet20", "ResNet32", "ResNet56", "ResNet18",
            "get_model"]
 
 
@@ -15,6 +17,10 @@ def get_model(name: str, num_classes: int = 10):
     name = name.lower()
     if name in ("cnn", "geocnn", "lenet"):
         return GeoCNN(num_classes=num_classes)
+    if name == "mlp":
+        return MLP(num_classes=num_classes)
+    if name == "alexnet":
+        return AlexNet(num_classes=num_classes)
     if name == "resnet20":
         return ResNet20(num_classes=num_classes)
     if name == "resnet32":

@@ -1,0 +1,57 @@
+"""Small dense models for the MNIST-class workloads.
+
+The reference's python frontend ships a gluon model zoo alongside the demo
+CNN (reference: python/mxnet/gluon/model_zoo/vision/ — alexnet.py,
+resnet.py, vgg.py, ...).  These are the dense members of ours: an MLP for
+quick convergence tests and an AlexNet-style net sized for 32x32 inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Dense net: flatten -> hidden relu layers -> logits."""
+
+    num_classes: int = 10
+    hidden: Sequence[int] = (256, 128)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        init = nn.initializers.xavier_uniform()
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, kernel_init=init, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, kernel_init=init,
+                        dtype=jnp.float32)(x)
+
+
+class AlexNet(nn.Module):
+    """AlexNet-style conv net adapted to 32x32 inputs (reference analogue:
+    python/mxnet/gluon/model_zoo/vision/alexnet.py, with the stem scaled
+    down so CIFAR-sized images survive the pooling pyramid)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        init = nn.initializers.xavier_uniform()
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(64, (3, 3), kernel_init=init, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(192, (3, 3), kernel_init=init, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), kernel_init=init, dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), kernel_init=init, dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), kernel_init=init, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.relu(nn.Dense(1024, kernel_init=init)(x))
+        x = nn.relu(nn.Dense(512, kernel_init=init)(x))
+        return nn.Dense(self.num_classes, kernel_init=init)(x)

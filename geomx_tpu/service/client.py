@@ -16,6 +16,7 @@ The process analogue of the reference's KVWorker
 from __future__ import annotations
 
 import itertools
+import pickle
 import random
 import socket
 import threading
@@ -23,8 +24,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu.service.protocol import (Msg, MsgType, env_int, recv_frame,
-                                        send_frame)
+from geomx_tpu.service.protocol import (Msg, MsgType, connect_retry, env_int,
+                                        recv_frame, send_frame)
 
 
 class _Pending:
@@ -50,7 +51,7 @@ class GeoPSClient:
             resend_timeout_ms = env_int(
                 ("GEOMX_RESEND_TIMEOUT", "PS_RESEND_TIMEOUT"), 1000)
         self.resend_timeout_ms = resend_timeout_ms
-        self._sock = socket.create_connection(addr)
+        self._sock = connect_retry(addr)
         self._wlock = threading.Lock()
         # random rid base so a restarted worker reusing a sender_id cannot
         # collide with its predecessor's (sender, rid) dedup signatures
@@ -96,7 +97,11 @@ class GeoPSClient:
         while not self._closed:
             try:
                 msg = recv_frame(self._sock)
-            except OSError:
+            except (OSError, pickle.UnpicklingError, ValueError):
+                # ValueError/UnpicklingError = malformed or rejected frame
+                # (see protocol._HeaderUnpickler); after it the stream
+                # position is untrustworthy, so treat like a dead socket —
+                # falling through releases every waiter
                 msg = None
             if msg is None:
                 # connection closed: release every waiter.  Entries stay in

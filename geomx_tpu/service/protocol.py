@@ -18,6 +18,7 @@ import io
 import pickle
 import socket
 import struct
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
@@ -41,6 +42,22 @@ class MsgType(enum.IntEnum):
     ACK = 9
     STOP = 10            # reference kStopServer
     ERROR = 11
+
+
+class _HeaderUnpickler(pickle.Unpickler):
+    """Headers are primitives only, and a pickle of primitives never needs
+    to resolve a global — so refuse all class lookups.  This closes the
+    arbitrary-code-execution hole unrestricted ``pickle.loads`` would open
+    once servers bind non-loopback interfaces (GEOMX_PS_BIND_HOST)."""
+
+    def find_class(self, module, name):
+        raise pickle.UnpicklingError(
+            f"wire header tried to load {module}.{name}; only primitive "
+            "types are allowed")
+
+
+def _header_loads(data: bytes):
+    return _HeaderUnpickler(io.BytesIO(data)).load()
 
 
 @dataclass
@@ -80,7 +97,7 @@ class Msg:
     @classmethod
     def decode(cls, frame: bytes) -> "Msg":
         hlen = _LEN.unpack_from(frame, 0)[0]
-        header = pickle.loads(frame[4:4 + hlen])
+        header = _header_loads(frame[4:4 + hlen])
         arr = None
         if "dtype" in header:
             arr = np.frombuffer(frame[4 + hlen:],
@@ -125,6 +142,31 @@ def should_drop(msg: Msg) -> bool:
     if not msg.meta.get("resend") or msg.meta.get("reliable"):
         return False
     return _drop_rng.random() * 100.0 < rate
+
+
+def connect_retry(addr, total_timeout_s: float = 30.0,
+                  interval_s: float = 0.25) -> socket.socket:
+    """create_connection with retry-until-deadline: cluster bring-up is not
+    strictly ordered (the launcher starts tiers with best-effort delays;
+    ssh + interpreter start times vary), so peers wait for their server to
+    come up instead of dying on the first ConnectionRefused — the same
+    spin the reference's Van does waiting for the scheduler."""
+    deadline = time.monotonic() + total_timeout_s
+    while True:
+        try:
+            sock = socket.create_connection(addr, timeout=10.0)
+            # the connect timeout must not persist as the operation timeout:
+            # PS sockets legitimately block >10s (sync pulls held for a
+            # straggling party, barriers), and a timeout mid-frame would
+            # desync the length-prefixed framing
+            sock.settimeout(None)
+            return sock
+        except socket.gaierror:
+            raise  # name resolution failure is not a bring-up race
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(interval_s)
 
 
 def send_frame(sock: socket.socket, msg: Msg) -> None:

@@ -21,6 +21,8 @@ wire buffers, decompressed here (server-side BSCDecompress).
 
 from __future__ import annotations
 
+import os
+import pickle
 import socket
 import threading
 import time
@@ -28,8 +30,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomx_tpu.service.protocol import (Msg, MsgType, recv_frame, send_frame,
-                                        should_drop)
+from geomx_tpu.service.protocol import (Msg, MsgType, connect_retry,
+                                        recv_frame, send_frame, should_drop)
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
 
 
@@ -54,7 +56,8 @@ class GeoPSServer:
                  heartbeat_timeout: float = 15.0,
                  accumulate: bool = False,
                  global_sender_id: Optional[int] = None,
-                 rank: int = 0):
+                 rank: int = 0,
+                 bind_host: Optional[str] = None):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -63,6 +66,7 @@ class GeoPSServer:
         self.mode = mode
         self.accumulate = accumulate
         self._tx = optimizer
+        self._tx_config = None
         self._opt_state: Dict[str, Any] = {}
         self._store: Dict[str, _KeyState] = {}
         self._lock = threading.Lock()
@@ -93,7 +97,11 @@ class GeoPSServer:
 
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("127.0.0.1", port))
+        # loopback by default (pseudo-distributed); multi-host deployments
+        # bind all interfaces via bind_host="0.0.0.0" or GEOMX_PS_BIND_HOST
+        if bind_host is None:
+            bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
+        self._srv.bind((bind_host, port))
         self._srv.listen(64)
         # a blocked accept() is not reliably woken by close() on Linux, so
         # poll with a short timeout and re-check _running
@@ -107,7 +115,7 @@ class GeoPSServer:
 
     def start(self):
         if self._global_addr is not None:
-            self._global_sock = socket.create_connection(self._global_addr)
+            self._global_sock = connect_retry(self._global_addr)
         self._accept_thread.start()
         return self
 
@@ -145,7 +153,9 @@ class GeoPSServer:
         while True:
             try:
                 msg = recv_frame(conn)
-            except OSError:
+            except (OSError, pickle.UnpicklingError, ValueError):
+                # malformed/rejected frame (protocol._HeaderUnpickler): the
+                # stream is desynced — drop the connection cleanly
                 return
             if msg is None:
                 return
@@ -246,14 +256,29 @@ class GeoPSServer:
                               meta=dict(msg.meta, reliable=True))
                     fwd.sender = self._global_sender_id
                     send_frame(self._global_sock, fwd)
-                    recv_frame(self._global_sock)
+                    reply = recv_frame(self._global_sock)
+                # a global-tier failure must reach the worker, not be
+                # swallowed into a blind ACK (it would train with the
+                # overwrite store and silently diverge)
+                if reply is None:
+                    self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                        "error": "global tier died during set_optimizer"}))
+                    return
+                if reply.type == MsgType.ERROR:
+                    self._reply(conn, msg, reply)
+                    return
             else:
-                from geomx_tpu.optim import get_optimizer
-                self._tx = get_optimizer(msg.meta["name"],
-                                         **msg.meta.get("kwargs", {}))
+                config = (msg.meta["name"], msg.meta.get("kwargs", {}))
                 with self._lock:
-                    for k, st in self._store.items():
-                        self._opt_state[k] = self._tx.init(st.value)
+                    # idempotent: every party's lead worker sends the same
+                    # config so ordering vs. first pushes is safe in async
+                    # mode; don't reset optimizer state on repeats
+                    if self._tx_config != config:
+                        from geomx_tpu.optim import get_optimizer
+                        self._tx = get_optimizer(config[0], **config[1])
+                        self._tx_config = config
+                        for k, st in self._store.items():
+                            self._opt_state[k] = self._tx.init(st.value)
         elif cmd == "set_gradient_compression":
             from geomx_tpu.compression import get_compressor
             self._compressor = get_compressor(msg.meta["spec"])

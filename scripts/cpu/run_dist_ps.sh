@@ -10,10 +10,12 @@
 # (fsa|mixed), GEOMX_COMPRESSION (e.g. "bsc,0.01" / "fp16"),
 # PS_RESEND/PS_RESEND_TIMEOUT/PS_DROP_MSG (reliability/fault injection).
 set -euo pipefail
-source "$(dirname "$0")/../common.sh"
-
+# default BEFORE common.sh (which defaults workers-per-party to 4 for the
+# SPMD scripts): the process-per-role demo wants the reference's 2x2
 : "${GEOMX_NUM_PARTIES:=2}"
 : "${GEOMX_WORKERS_PER_PARTY:=2}"
+source "$(dirname "$0")/../common.sh"
+
 : "${GEOMX_PS_GLOBAL_PORT:=19700}"
 : "${GEOMX_PS_PORT:=19800}"
 : "${GEOMX_EPOCHS:=3}"

@@ -1,0 +1,36 @@
+"""Launcher (tracker) integration test.
+
+Reference analogue: the dmlc trackers spawn the whole pseudo-distributed
+cluster on localhost (3rdparty/ps-lite/tests/local.sh pattern).  Here the
+launcher runs the real multi-process HiPS PS demo end-to-end, all-local.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_local_launch_end_to_end():
+    env = dict(os.environ)
+    env.update({
+        "GEOMX_EPOCHS": "1",
+        "GEOMX_BATCH": "64",
+        # unique ports per run: back-to-back runs on fixed ports can
+        # collide with a predecessor's lingering listener
+        "GEOMX_PS_GLOBAL_PORT": str(20000 + os.getpid() % 10000),
+        "GEOMX_PS_PORT": str(31000 + os.getpid() % 10000),
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("XLA_FLAGS", None)  # single-device CPU is fine for the workers
+    proc = subprocess.run(
+        [sys.executable, "scripts/launch.py",
+         "--num-parties", "2", "--workers-per-party", "1",
+         "--server-start-delay", "0.5",
+         "--", sys.executable, "examples/dist_ps.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    # every worker reported accuracy and the servers stopped cleanly
+    assert proc.stdout.count("test_acc") >= 2, proc.stdout
+    assert "[global_server] stopped" in proc.stdout, proc.stdout

@@ -205,3 +205,27 @@ def test_error_reply_for_unknown_key():
     with pytest.raises(RuntimeError, match="no key"):
         c.pull("missing")
     server.stop()
+
+
+def test_wire_header_rejects_code_loading_pickles():
+    """The wire header decoder must refuse pickles that resolve globals —
+    that is the remote-code-execution vector once servers bind
+    non-loopback interfaces (GEOMX_PS_BIND_HOST=0.0.0.0)."""
+    import pickle
+    import struct
+
+    from geomx_tpu.service.protocol import Msg, MsgType
+
+    # round trip of a legitimate primitive header still works
+    m = Msg(MsgType.PUSH, key="w", sender=3,
+            meta={"rid": 7, "resend": True, "nested": [1, 2.5, ("a", None)]},
+            array=np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = Msg.decode(m.encode())
+    assert out.meta == m.meta and np.array_equal(out.array, m.array)
+
+    # a crafted header that would import a callable must be rejected
+    evil = pickle.dumps({"t": 1, "k": None, "s": 0,
+                         "m": {"f": np.frombuffer}}, protocol=4)
+    frame = struct.pack("<I", len(evil)) + evil
+    with pytest.raises(pickle.UnpicklingError):
+        Msg.decode(frame)
