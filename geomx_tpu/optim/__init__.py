@@ -14,14 +14,36 @@ import optax
 
 
 def get_optimizer(name: str, learning_rate=0.01, **kw):
-    """Reference demo defaults: Adam lr 0.01 (examples/cnn.py:32,72)."""
+    """Factory over the reference's optimizer suite
+    (python/mxnet/optimizer/optimizer.py registers sgd, nag, rmsprop,
+    adam, adagrad, adadelta, adamax, nadam, ftrl, dcasgd, ...), mapped to
+    the optax equivalents.  Reference demo defaults: Adam lr 0.01
+    (examples/cnn.py:32,72)."""
     name = name.lower()
     if name == "adam":
         return optax.adam(learning_rate, **kw)
+    if name == "adamw":
+        return optax.adamw(learning_rate, **kw)
     if name == "sgd":
         return optax.sgd(learning_rate, **kw)
     if name == "momentum":
         return optax.sgd(learning_rate, momentum=kw.pop("momentum", 0.9), **kw)
+    if name == "nag":
+        kw.pop("nesterov", None)  # implied by the name
+        return optax.sgd(learning_rate, momentum=kw.pop("momentum", 0.9),
+                         nesterov=True, **kw)
+    if name == "rmsprop":
+        return optax.rmsprop(learning_rate, **kw)
+    if name == "adagrad":
+        return optax.adagrad(learning_rate, **kw)
+    if name == "adadelta":
+        return optax.adadelta(learning_rate, **kw)
+    if name == "adamax":
+        return optax.adamax(learning_rate, **kw)
+    if name == "nadam":
+        return optax.nadam(learning_rate, **kw)
+    if name == "lamb":
+        return optax.lamb(learning_rate, **kw)
     if name == "dcasgd":
         return dcasgd(learning_rate, **kw)
     raise ValueError(f"Unknown optimizer: {name!r}")

@@ -191,6 +191,70 @@ class KVStore:
             return out
         return v
 
+    # ---- row-sparse path (reference row_sparse storage: python
+    # kvstore.py row_sparse_pull:300-360, EncodeRowSparseKey
+    # src/kvstore/kvstore_dist.h:874-906) --------------------------------
+    def push_row_sparse(self, key, row_ids, values, priority: int = 0):
+        """Push only the touched rows of a 2D+ parameter (embedding-style
+        sparse gradients).  ``row_ids`` [k] indexes rows of the stored
+        tensor; ``values`` [k, ...] are their gradients.  Lists of
+        (row_ids, values) pairs are the multi-worker push; duplicate rows
+        accumulate, matching row-sparse gradient summation.
+
+        With an optimizer set, the update is **lazy**: it runs only on the
+        touched rows (gather rows of params and optimizer state, update,
+        scatter back) — the reference's row_sparse optimizer semantics,
+        where untouched rows see no weight decay or momentum drift
+        (src/operator/optimizer_op: row_sparse sgd/adam update kernels)."""
+        if key not in self._store:
+            raise KeyError(f"push to uninitialized key {key!r}")
+        ref = self._store[key]
+        if not isinstance(row_ids, (list, tuple)):
+            row_ids, values = [row_ids], [values]
+        if len(row_ids) != len(values):
+            raise ValueError(
+                f"{len(row_ids)} row_id lists vs {len(values)} value lists")
+        all_r = np.concatenate([np.asarray(r, np.int64).ravel()
+                                for r in row_ids])
+        all_v = jnp.concatenate(
+            [jnp.asarray(v, ref.dtype).reshape((-1,) + ref.shape[1:])
+             for v in values])
+
+        if self._tx is None:
+            # aggregation semantics (local tier): one scatter-add of the
+            # concatenated contributions, then the usual dense push
+            grad = jnp.zeros_like(ref).at[jnp.asarray(all_r)].add(all_v)
+            self.push(key, grad, priority=priority)
+            return
+
+        # lazy update: unique touched rows (host-side — the imperative
+        # store is not jitted, so the data-dependent size is fine)
+        uniq, inverse = np.unique(all_r, return_inverse=True)
+        rows = jnp.asarray(uniq)
+        grad_rows = jnp.zeros((len(uniq),) + ref.shape[1:], ref.dtype)
+        grad_rows = grad_rows.at[jnp.asarray(inverse)].add(all_v)
+
+        is_rowwise = lambda leaf: (
+            hasattr(leaf, "shape") and leaf.shape == ref.shape)
+        gather = lambda leaf: leaf[rows] if is_rowwise(leaf) else leaf
+        param_rows = ref[rows]
+        state_rows = jax.tree.map(gather, self._opt_state[key])
+        updates, new_state_rows = self._tx.update(
+            grad_rows, state_rows, param_rows)
+        self._store[key] = ref.at[rows].set(
+            optax.apply_updates(param_rows, updates))
+        self._opt_state[key] = jax.tree.map(
+            lambda full, part: full.at[rows].set(part)
+            if is_rowwise(full) else part,
+            self._opt_state[key], new_state_rows)
+
+    def row_sparse_pull(self, key, row_ids, priority: int = 0):
+        """Pull only the requested rows (reference: workers pull just the
+        embedding rows their batch touches)."""
+        if key not in self._store:
+            raise KeyError(f"pull of uninitialized key {key!r}")
+        return self._store[key][jnp.asarray(row_ids, jnp.int32)]
+
     # ---- optimizer state persistence (kvstore.py:566-592) ------------------
     def save_optimizer_states(self, fname: str):
         with open(fname, "wb") as f:

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# The multi-process HiPS PS topology on a TPU VM: one OS process per node
+# role, like scripts/cpu/run_dist_ps.sh but with workers free to use the
+# real accelerator.  For multi-host TPU deployments use scripts/launch.py
+# with a hostfile (docs/deployment.md).
+# Reference analogue: scripts/gpu/run_vanilla_hips.sh's process model.
+set -euo pipefail
+: "${GEOMX_NUM_PARTIES:=2}"
+: "${GEOMX_WORKERS_PER_PARTY:=2}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY
+exec "$(dirname "$0")/../cpu/run_dist_ps.sh" "$@"

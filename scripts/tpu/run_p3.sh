@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# P3 (Priority-based Parameter Propagation) on TPU hosts: the priority
+# queue lives on the host-side PS path, so this runs the multi-process PS
+# topology on the TPU VM (workers push with priority=-layer_index).
+# Reference analogue: scripts/gpu/run_p3.sh (ENABLE_P3=1).
+set -euo pipefail
+export GEOMX_ENABLE_P3=1
+exec "$(dirname "$0")/run_dist_ps.sh" "$@"

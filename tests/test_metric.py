@@ -1,0 +1,68 @@
+"""mx.metric-surface parity tests (reference python/mxnet/metric.py)."""
+
+import numpy as np
+import pytest
+
+from geomx_tpu import metric
+
+
+def test_accuracy_from_logits_and_labels():
+    m = metric.create("acc")
+    labels = np.array([0, 1, 2, 1])
+    logits = np.eye(3)[[0, 1, 0, 1]]  # 3 of 4 correct
+    m.update(labels, logits)
+    name, value = m.get()
+    assert name == "accuracy"
+    assert value == pytest.approx(0.75)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_accuracy():
+    m = metric.TopKAccuracy(top_k=2)
+    labels = np.array([2, 0])
+    preds = np.array([[0.1, 0.5, 0.4],   # top2 = {1,2} -> hit
+                      [0.1, 0.5, 0.4]])  # top2 = {1,2} -> miss
+    m.update(labels, preds)
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1_binary():
+    m = metric.F1()
+    labels = np.array([1, 1, 0, 0])
+    preds = np.array([1, 0, 1, 0])  # tp=1 fp=1 fn=1 -> P=R=0.5 -> F1=0.5
+    m.update(labels, preds)
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_regression_metrics():
+    labels = np.array([1.0, 2.0, 3.0])
+    preds = np.array([2.0, 2.0, 1.0])
+    assert metric.create("mae").get()[0] == "mae"
+    mae, mse, rmse = (metric.create(n) for n in ("mae", "mse", "rmse"))
+    for m in (mae, mse, rmse):
+        m.update(labels, preds)
+    assert mae.get()[1] == pytest.approx(1.0)
+    assert mse.get()[1] == pytest.approx(5 / 3)
+    assert rmse.get()[1] == pytest.approx(np.sqrt(5 / 3))
+
+
+def test_cross_entropy():
+    m = metric.create("ce")
+    labels = np.array([0, 1])
+    probs = np.array([[0.5, 0.5], [0.25, 0.75]])
+    m.update(labels, probs)
+    expect = -(np.log(0.5) + np.log(0.75)) / 2
+    assert m.get()[1] == pytest.approx(expect)
+
+
+def test_composite_and_factory():
+    m = metric.create(["acc", "ce"])
+    labels = np.array([1])
+    probs = np.array([[0.2, 0.8]])
+    m.update(labels, probs)
+    pairs = dict(m.get_name_value())
+    assert pairs["accuracy"] == pytest.approx(1.0)
+    assert pairs["cross-entropy"] == pytest.approx(-np.log(0.8))
+    with pytest.raises(ValueError):
+        metric.create("nope")

@@ -136,3 +136,62 @@ def test_mixed_sync_dcasgd_opt_in():
     assert plain.dcasgd_lambda == 0.0
     comp = get_sync_algorithm(GeoConfig(sync_mode="dist_async", dcasgd=True))
     assert comp.dcasgd_lambda == pytest.approx(0.04)
+
+
+def test_row_sparse_push_pull():
+    """Row-sparse push scatter-adds touched rows; row_sparse_pull gathers
+    only requested rows (reference kvstore.py row_sparse_pull,
+    EncodeRowSparseKey kvstore_dist.h:874-906)."""
+    kv = create("local")
+    kv.init("emb", np.zeros((6, 3), np.float32))
+
+    # two workers touch overlapping rows: duplicates accumulate
+    kv.push_row_sparse(
+        "emb",
+        [np.array([0, 2]), np.array([2, 5])],
+        [np.ones((2, 3), np.float32), 2 * np.ones((2, 3), np.float32)])
+    got = np.asarray(kv.pull("emb"))
+    np.testing.assert_allclose(got[0], 1.0)
+    np.testing.assert_allclose(got[2], 3.0)   # 1 + 2
+    np.testing.assert_allclose(got[5], 2.0)
+    np.testing.assert_allclose(got[1], 0.0)
+
+    rows = np.asarray(kv.row_sparse_pull("emb", np.array([2, 0])))
+    np.testing.assert_allclose(rows[0], 3.0)
+    np.testing.assert_allclose(rows[1], 1.0)
+
+
+def test_row_sparse_push_with_optimizer():
+    kv = create("local")
+    kv.init("emb", np.ones((4, 2), np.float32))
+    kv.set_optimizer(optax.sgd(0.5))
+    kv.push_row_sparse("emb", np.array([1, 3]),
+                       np.ones((2, 2), np.float32))
+    got = np.asarray(kv.pull("emb"))
+    np.testing.assert_allclose(got[1], 0.5)   # 1 - 0.5*1
+    np.testing.assert_allclose(got[0], 1.0)   # untouched rows keep value
+
+
+def test_row_sparse_lazy_update_leaves_untouched_rows_alone():
+    """Stateful/decaying optimizers must not move untouched rows — the
+    reference's lazy row_sparse update semantics
+    (src/operator/optimizer_op row_sparse kernels)."""
+    kv = create("local")
+    kv.init("emb", np.ones((4, 2), np.float32))
+    kv.set_optimizer(optax.adamw(0.1, weight_decay=0.1))
+    kv.push_row_sparse("emb", np.array([1]), np.ones((1, 2), np.float32))
+    got1 = np.asarray(kv.pull("emb"))
+    np.testing.assert_allclose(got1[0], 1.0)   # no weight decay leaked
+    assert got1[1, 0] < 1.0                    # touched row updated
+
+    # a second push touching a DIFFERENT row must not apply stale
+    # momentum to the previously-touched row
+    kv.push_row_sparse("emb", np.array([2]), np.ones((1, 2), np.float32))
+    got2 = np.asarray(kv.pull("emb"))
+    np.testing.assert_allclose(got2[1], got1[1])
+    assert got2[2, 0] < 1.0
+
+    # mismatched worker lists raise instead of silently truncating
+    with pytest.raises(ValueError, match="row_id lists"):
+        kv.push_row_sparse("emb", [np.array([0]), np.array([1])],
+                           [np.ones((1, 2), np.float32)])

@@ -175,3 +175,22 @@ def test_evaluate_scores_every_sample(data):
     acc2 = trainer.evaluate(state, data["test_x"][:300], data["test_y"][:300],
                             batch_size=100)
     assert acc1 == pytest.approx(acc2, abs=1e-9)
+
+
+def test_optimizer_registry_covers_reference_suite():
+    """Every mapped reference optimizer name builds and takes a step
+    (reference python/mxnet/optimizer/optimizer.py registrations)."""
+    import jax.numpy as jnp
+
+    from geomx_tpu.optim import get_optimizer
+
+    params = {"w": jnp.ones((3,))}
+    grads = {"w": jnp.full((3,), 0.1)}
+    for name in ("sgd", "momentum", "nag", "adam", "adamw", "rmsprop",
+                 "adagrad", "adadelta", "adamax", "nadam", "lamb", "dcasgd"):
+        tx = get_optimizer(name, learning_rate=0.01)
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        import optax as _optax
+        new = _optax.apply_updates(params, updates)
+        assert jnp.all(jnp.isfinite(new["w"])), name
