@@ -21,6 +21,9 @@ def run(extra_args=(), config_fn=lambda a: {}, sync_default="fsa"):
     parser.add_argument("-d", "--dataset", default="mnist",
                         choices=["mnist", "fashion-mnist", "cifar10", "synthetic"])
     parser.add_argument("--model", default="cnn")
+    parser.add_argument("--augment", action="store_true",
+                        help="random-crop + flip augmentation "
+                             "(the CIFAR training recipe)")
     for flags_short, flags_long, typ, default in extra_args:
         parser.add_argument(flags_short, flags_long, type=typ, default=default)
     args = parser.parse_args()
@@ -48,7 +51,8 @@ def run(extra_args=(), config_fn=lambda a: {}, sync_default="fsa"):
     state = trainer.init_state(jax.random.PRNGKey(0), data["train_x"][:2])
     loader = trainer.make_loader(data["train_x"], data["train_y"],
                                  args.batch_size,
-                                 split_by_class=args.split_by_class)
+                                 split_by_class=args.split_by_class,
+                                 augment=args.augment)
 
     print(f"Start training on {topo.total_workers} workers "
           f"({topo.num_parties} parties x {topo.workers_per_party}), "

@@ -13,4 +13,8 @@ from cnn_common import run
 if __name__ == "__main__":
     import sys
     sys.argv += ["--model", "resnet20", "--dataset", "cifar10"]
+    if "--no-augment" in sys.argv:
+        sys.argv.remove("--no-augment")
+    else:
+        sys.argv += ["--augment"]   # the CIFAR recipe needs crop+flip
     run(extra_args=[("-ee", "--eval-every", int, 50)])

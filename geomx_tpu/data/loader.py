@@ -26,14 +26,23 @@ class GeoDataLoader:
     def __init__(self, x: np.ndarray, y: np.ndarray, topology: HiPSTopology,
                  batch_size: int, split_by_class: bool = False,
                  shuffle: bool = True, seed: int = 0, drop_last: bool = True,
-                 sharding: Optional[jax.sharding.Sharding] = None):
+                 sharding: Optional[jax.sharding.Sharding] = None,
+                 augment: bool = False, pad: int = 4):
         """``batch_size`` is per-worker, matching the reference's -bs flag
-        (each worker process trains batch_size samples per step)."""
+        (each worker process trains batch_size samples per step).
+
+        ``augment=True`` applies the standard CIFAR recipe on host —
+        random crop from a ``pad``-pixel reflection border + horizontal
+        flip (the reference's gluon transforms path,
+        python/mxnet/gluon/data/vision/transforms.py RandomResizedCrop /
+        RandomFlipLeftRight as used by its CIFAR training recipes)."""
         self.topology = topology
         self.batch_size = int(batch_size)
         self.sharding = sharding
         self.shuffle = shuffle
         self.seed = seed
+        self.augment = augment
+        self.pad = int(pad)
         n_workers = topology.total_workers
         length = len(x)
         if split_by_class:
@@ -64,7 +73,10 @@ class GeoDataLoader:
         b = self.batch_size
         for step in range(self.steps_per_epoch):
             sel = np.stack([idx[step * b:(step + 1) * b] for idx in order])
-            xb = self.x[sel.reshape(-1)].reshape(
+            xflat = self.x[sel.reshape(-1)]
+            if self.augment:
+                xflat = self._augment_batch(xflat, rng)
+            xb = xflat.reshape(
                 (topo.num_parties, topo.workers_per_party, b) + self.x.shape[1:])
             yb = self.y[sel.reshape(-1)].reshape(
                 (topo.num_parties, topo.workers_per_party, b))
@@ -72,3 +84,20 @@ class GeoDataLoader:
                 xb = jax.device_put(xb, self.sharding)
                 yb = jax.device_put(yb, self.sharding)
             yield xb, yb
+
+    def _augment_batch(self, x: np.ndarray,
+                       rng: np.random.RandomState) -> np.ndarray:
+        """Vectorized random crop (reflection pad) + horizontal flip."""
+        n, h, w = x.shape[:3]
+        p = self.pad
+        padded = np.pad(x, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+        dy = rng.randint(0, 2 * p + 1, size=n)
+        dx = rng.randint(0, 2 * p + 1, size=n)
+        # gather shifted windows with one fancy-index (no python loop)
+        rows = dy[:, None] + np.arange(h)[None, :]          # [n, h]
+        cols = dx[:, None] + np.arange(w)[None, :]          # [n, w]
+        out = padded[np.arange(n)[:, None, None],
+                     rows[:, :, None], cols[:, None, :]]
+        flip = rng.rand(n) < 0.5
+        out[flip] = out[flip, :, ::-1]
+        return out

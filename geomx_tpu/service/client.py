@@ -40,8 +40,17 @@ class _Pending:
 
 class GeoPSClient:
     def __init__(self, addr: Tuple[str, int], sender_id: int = 0,
-                 resend_timeout_ms: Optional[int] = None):
+                 resend_timeout_ms: Optional[int] = None,
+                 auto_pull: bool = False):
+        """``auto_pull=True`` registers this client for server-initiated
+        updates (the TSEngine AutoPull path): after each aggregation round
+        the server pushes fresh values in throughput-scheduled order, and
+        ``auto_pull(key)`` consumes them instead of issuing a PULL."""
         self.sender_id = sender_id
+        self._autopull: Dict[str, Any] = {}
+        self._apevents: Dict[str, threading.Event] = {}
+        self._aplock = threading.Lock()
+        self._ap_closed = False
         # reliability: when PS_RESEND/GEOMX_RESEND is on (or a timeout is
         # given), un-ACKed requests are retransmitted after
         # PS_RESEND_TIMEOUT ms — the reference Resender (src/resender.h);
@@ -66,6 +75,9 @@ class GeoPSClient:
         self._sender.start()
         self._receiver = threading.Thread(target=self._recv_loop, daemon=True)
         self._receiver.start()
+        if auto_pull:
+            self._request(Msg(MsgType.COMMAND,
+                              meta={"cmd": "register_autopull"}))
 
     @staticmethod
     def _make_queue():
@@ -111,7 +123,23 @@ class GeoPSClient:
                 with self._plock:
                     for p in self._pending.values():
                         p.event.set()
+                # ... and fail auto_pull() waiters fast instead of letting
+                # them poll out their timeout on a dead connection
+                with self._aplock:
+                    self._ap_closed = True
+                    for ev in self._apevents.values():
+                        ev.set()
                 return
+            if msg.type == MsgType.AUTOPULL:
+                # unsolicited server-initiated update (TSEngine AutoPull):
+                # no rid — park it for auto_pull() waiters
+                with self._aplock:
+                    self._autopull[msg.key] = (
+                        msg.meta.get("version", 0), msg.array)
+                    ev = self._apevents.setdefault(msg.key,
+                                                   threading.Event())
+                ev.set()
+                continue
             rid = msg.meta.get("rid")
             with self._plock:
                 p = self._pending.get(rid)
@@ -203,6 +231,28 @@ class GeoPSClient:
 
     def pull_async(self, key: str, priority: int = 0) -> int:
         return self._submit(Msg(MsgType.PULL, key=key), priority=priority)
+
+    def auto_pull(self, key: str, min_version: int = 0,
+                  timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Wait for a server-initiated update of ``key`` with version >=
+        ``min_version`` (reference KVWorker::AutoPull, kv_app.h:364: the
+        worker blocks until the TSEngine dissemination reaches it)."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            with self._aplock:
+                got = self._autopull.get(key)
+                ev = self._apevents.setdefault(key, threading.Event())
+                if got is not None and got[0] >= min_version:
+                    return np.asarray(got[1], np.float32)
+                if self._ap_closed:
+                    raise ConnectionError("server closed")
+                ev.clear()
+            remain = None if deadline is None else \
+                deadline - _time.monotonic()
+            if remain is not None and remain <= 0:
+                raise TimeoutError(f"auto_pull({key!r}) timed out")
+            ev.wait(remain if remain is None else min(remain, 1.0))
 
     def barrier(self, timeout: Optional[float] = 120.0) -> None:
         """Tier-wide barrier (reference kvstore.py:_barrier): returns once

@@ -42,6 +42,8 @@ class MsgType(enum.IntEnum):
     ACK = 9
     STOP = 10            # reference kStopServer
     ERROR = 11
+    AUTOPULL = 12        # server-initiated update (TSEngine AutoPull,
+                         # reference kv_app.h:364 / AUTOPULLREPLY)
 
 
 class _HeaderUnpickler(pickle.Unpickler):

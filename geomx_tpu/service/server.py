@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import queue
 import socket
 import threading
 import time
@@ -57,7 +58,9 @@ class GeoPSServer:
                  accumulate: bool = False,
                  global_sender_id: Optional[int] = None,
                  rank: int = 0,
-                 bind_host: Optional[str] = None):
+                 bind_host: Optional[str] = None,
+                 auto_pull: Optional[bool] = None,
+                 max_greed_rate: Optional[float] = None):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -75,6 +78,31 @@ class GeoPSServer:
         self._seen_pushes: Dict[Any, bool] = {}
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
         self.rank = rank
+        self._conn_wlocks: Dict[int, threading.Lock] = {}
+        self._conns: set = set()
+        # TSEngine AutoPull (reference ENABLE_INTRA_TS, van.cc:447-454):
+        # after each sync round the server pushes the fresh value to
+        # registered workers in throughput-scheduled order instead of
+        # waiting for their pulls (DefaultAutoPull -> AutoPullUpdate,
+        # kvstore_dist_server.h:1372-1395, kv_app.h:658-691)
+        if auto_pull is None:
+            auto_pull = bool(int(os.environ.get(
+                "GEOMX_ENABLE_INTRA_TS",
+                os.environ.get("ENABLE_INTRA_TS", "0")) or 0))
+        self.ts_sched = None
+        if auto_pull:
+            from geomx_tpu.transport.tsengine import TSEngineScheduler
+            if max_greed_rate is None:
+                max_greed_rate = float(os.environ.get(
+                    "GEOMX_MAX_GREED_RATE",
+                    os.environ.get("MAX_GREED_RATE_TS", "0.9")) or 0.9)
+            self.ts_sched = TSEngineScheduler(num_workers,
+                                              max_greed_rate=max_greed_rate,
+                                              seed=rank)
+        self._ap_conns: Dict[int, Any] = {}   # scheduler index -> conn
+        self._ap_ids: Dict[int, int] = {}     # sender id -> scheduler index
+        self._ap_queue: "queue.Queue" = queue.Queue()
+        self._ap_thread: Optional[threading.Thread] = None
         # remotely-controllable profiler (reference kSetProfilerParams,
         # kvstore_dist_server.h:383-430)
         from geomx_tpu.utils.profiler import Profiler
@@ -117,6 +145,10 @@ class GeoPSServer:
         if self._global_addr is not None:
             self._global_sock = connect_retry(self._global_addr)
         self._accept_thread.start()
+        if self.ts_sched is not None:
+            self._ap_thread = threading.Thread(target=self._autopull_loop,
+                                               daemon=True)
+            self._ap_thread.start()
         return self
 
     def stop(self):
@@ -125,6 +157,19 @@ class GeoPSServer:
             self._srv.close()
         except OSError:
             pass
+        # drop live worker connections so their clients fail fast instead
+        # of waiting on a server that will never answer.  shutdown() (not
+        # just close()) is required: the serve thread blocked in recv holds
+        # the fd open, so close() alone would never send the FIN
+        for c in list(self._conns):
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         if self._global_sock is not None:
             try:
                 send_frame(self._global_sock, Msg(MsgType.STOP))
@@ -146,10 +191,18 @@ class GeoPSServer:
             except OSError:
                 return
             conn.settimeout(None)  # per-connection sockets block normally
+            self._conns.add(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket):
+        try:
+            self._serve_conn_loop(conn)
+        finally:
+            self._conn_wlocks.pop(id(conn), None)  # don't leak per-conn locks
+            self._conns.discard(conn)
+
+    def _serve_conn_loop(self, conn: socket.socket):
         while True:
             try:
                 msg = recv_frame(conn)
@@ -171,13 +224,20 @@ class GeoPSServer:
 
     # ---- request handling (the DataHandleEx dispatch) ----------------------
 
-    @staticmethod
-    def _reply(conn, req: Msg, reply: Msg):
+    def _send_msg(self, conn, msg: Msg):
+        """Per-connection write lock: AUTOPULL pushes race the serve
+        thread's replies on the same socket, and interleaved frames would
+        corrupt the length-prefixed stream."""
+        lock = self._conn_wlocks.setdefault(id(conn), threading.Lock())
+        with lock:
+            send_frame(conn, msg)
+
+    def _reply(self, conn, req: Msg, reply: Msg):
         """Echo the request id so async clients can match replies."""
         rid = req.meta.get("rid")
         if rid is not None:
             reply.meta["rid"] = rid
-        send_frame(conn, reply)
+        self._send_msg(conn, reply)
 
     def _handle(self, conn, msg: Msg) -> bool:
         t = msg.type
@@ -225,7 +285,7 @@ class GeoPSServer:
                         rel = Msg(MsgType.BARRIER_RELEASE)
                         if rid is not None:
                             rel.meta["rid"] = rid
-                        send_frame(c, rel)
+                        self._send_msg(c, rel)
                     self._barrier_waiters = []
         elif t == MsgType.COMMAND:
             self._handle_command(conn, msg)
@@ -286,6 +346,25 @@ class GeoPSServer:
                 self._comp_state = {
                     k: self._compressor.init_leaf_state(st.value)
                     for k, st in self._store.items()}
+        elif cmd == "register_autopull":
+            # client opts into server-initiated updates; indices drive the
+            # TSEngine scheduler.  A reconnecting worker (same sender id)
+            # reclaims its slot; a table overflow is a real error, not a
+            # silent ACK that would leave the client waiting forever.
+            with self._lock:
+                if self.ts_sched is None:
+                    self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                        "error": "server not in auto_pull mode"}))
+                    return
+                idx = self._ap_ids.get(msg.sender)
+                if idx is None:
+                    idx = len(self._ap_ids)
+                    if idx >= self.ts_sched.n:
+                        self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                            "error": "autopull table full"}))
+                        return
+                    self._ap_ids[msg.sender] = idx
+                self._ap_conns[idx] = conn
         elif cmd == "set_profiler_params":
             self.profiler.set_config(**msg.meta.get("params", {}))
         elif cmd == "profiler_start":
@@ -428,7 +507,12 @@ class GeoPSServer:
                 st.value = fresh
             else:
                 self._apply(key, grad)
+            st.round += 1
             self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+            if self.ts_sched is not None:
+                # async intra-TS: disseminate after every apply, like the
+                # reference's TS_ApplyUpdates -> DefaultAutoPull
+                self._ap_queue.put((key, st.value, st.round))
             return
         st.merged = grad if st.merged is None else st.merged + grad
         st.count += 1
@@ -448,10 +532,54 @@ class GeoPSServer:
                                 array=st.value)
                     if rid is not None:
                         reply.meta["rid"] = rid
-                    send_frame(c, reply)
+                    self._send_msg(c, reply)
                 else:
                     still.append((c, rid, need))
             st.waiting_pulls = still
+            if self.ts_sched is not None:
+                # hand the snapshot to the distributor thread: blocking
+                # sends must not run under self._lock (a stalled client
+                # would freeze the whole tier)
+                self._ap_queue.put((key, st.value, st.round))
+
+    def _autopull_loop(self):
+        while self._running or not self._ap_queue.empty():
+            try:
+                item = self._ap_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._autopull_distribute(*item)
+
+    def _autopull_distribute(self, key: str, value: np.ndarray,
+                             round_: int):
+        """One TSEngine dissemination round: ASK the scheduler for
+        receivers in measured-throughput order, send the fresh value to
+        each, and report the observed throughput back (the server-side
+        half of AutoPullUpdate; send-side timing stands in for the
+        reference's receiver-measured piggyback).  Runs on the distributor
+        thread, never under the store lock."""
+        from geomx_tpu.transport.tsengine import STOP
+        sched = self.ts_sched
+        version = sched.iters + 1
+        while True:
+            r = sched.ask(0, version)
+            if r == STOP:
+                return
+            conn = self._ap_conns.get(r)
+            if conn is None:
+                continue  # ask() marked the index busy; nothing to send
+            msg = Msg(MsgType.AUTOPULL, key=key, array=value,
+                      meta={"version": round_})
+            t0 = time.perf_counter()
+            try:
+                self._send_msg(conn, msg)
+            except OSError:
+                # dead receiver: evict so later rounds stop paying for it
+                # (a reconnecting worker re-registers under its sender id)
+                self._ap_conns.pop(r, None)
+                continue
+            dt = max(time.perf_counter() - t0, 1e-9)
+            sched.report(0, r, value.nbytes / dt, version)
 
     def _handle_pull(self, conn, msg: Msg):
         with self._lock:

@@ -201,11 +201,13 @@ class KVStore:
         (row_ids, values) pairs are the multi-worker push; duplicate rows
         accumulate, matching row-sparse gradient summation.
 
-        With an optimizer set, the update is **lazy**: it runs only on the
-        touched rows (gather rows of params and optimizer state, update,
-        scatter back) — the reference's row_sparse optimizer semantics,
-        where untouched rows see no weight decay or momentum drift
-        (src/operator/optimizer_op: row_sparse sgd/adam update kernels)."""
+        Untouched rows are never modified: without an optimizer the
+        contributions scatter-add into the stored value (row-sparse
+        accumulation); with one, the update is **lazy** — it runs only on
+        the touched rows (gather rows of params and optimizer state,
+        update, scatter back) — the reference's row_sparse optimizer
+        semantics, where untouched rows see no weight decay or momentum
+        drift (src/operator/optimizer_op: row_sparse sgd/adam kernels)."""
         if key not in self._store:
             raise KeyError(f"push to uninitialized key {key!r}")
         ref = self._store[key]
@@ -220,11 +222,16 @@ class KVStore:
             [jnp.asarray(v, ref.dtype).reshape((-1,) + ref.shape[1:])
              for v in values])
 
-        if self._tx is None:
-            # aggregation semantics (local tier): one scatter-add of the
-            # concatenated contributions, then the usual dense push
+        if self._tx is None and self._updater is None:
+            # aggregation semantics: contributions scatter-add INTO the
+            # stored value, leaving untouched rows alone (row-sparse
+            # accumulation; a dense-push overwrite would zero every row
+            # this push didn't mention)
+            self._store[key] = ref.at[jnp.asarray(all_r)].add(all_v)
+            return
+        if self._updater is not None:
             grad = jnp.zeros_like(ref).at[jnp.asarray(all_r)].add(all_v)
-            self.push(key, grad, priority=priority)
+            self._store[key] = jnp.asarray(self._updater(key, grad, ref))
             return
 
         # lazy update: unique touched rows (host-side — the imperative

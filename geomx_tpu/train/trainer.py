@@ -71,10 +71,10 @@ class Trainer:
         )
 
     def make_loader(self, x, y, batch_size: int, split_by_class: bool = False,
-                    seed: int = 0) -> GeoDataLoader:
+                    seed: int = 0, augment: bool = False) -> GeoDataLoader:
         return GeoDataLoader(x, y, self.topology, batch_size,
                              split_by_class=split_by_class, seed=seed,
-                             sharding=self._batch_sharding)
+                             sharding=self._batch_sharding, augment=augment)
 
     def evaluate(self, state: TrainState, x: np.ndarray, y: np.ndarray,
                  batch_size: int = 512) -> float:

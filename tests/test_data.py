@@ -59,3 +59,32 @@ def test_loader_disjoint_shards():
     for i in range(len(shards)):
         for j in range(i + 1, len(shards)):
             assert not shards[i] & shards[j]
+
+
+def test_loader_augmentation_preserves_shapes_and_labels():
+    """Random crop (reflect pad) + flip: same shapes/dtype, labels
+    untouched, content actually changes, and the seed makes it
+    deterministic."""
+    import numpy as np
+
+    from geomx_tpu.data.loader import GeoDataLoader
+    from geomx_tpu.topology import HiPSTopology
+
+    topo = HiPSTopology(1, 1)
+    rng = np.random.RandomState(3)
+    x = (rng.rand(64, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, 64).astype(np.int32)
+
+    plain = GeoDataLoader(x, y, topo, batch_size=16, shuffle=False, seed=7)
+    aug = GeoDataLoader(x, y, topo, batch_size=16, shuffle=False, seed=7,
+                        augment=True)
+    aug2 = GeoDataLoader(x, y, topo, batch_size=16, shuffle=False, seed=7,
+                         augment=True)
+
+    (xp, yp), (xa, ya), (xa2, _) = (next(iter(l.epoch(0)))
+                                    for l in (plain, aug, aug2))
+    xp, xa, xa2 = (np.asarray(v) for v in (xp, xa, xa2))
+    assert xa.shape == xp.shape and xa.dtype == xp.dtype
+    np.testing.assert_array_equal(np.asarray(ya), np.asarray(yp))
+    assert not np.array_equal(xa, xp)          # something moved
+    np.testing.assert_array_equal(xa, xa2)     # seeded determinism

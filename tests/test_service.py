@@ -229,3 +229,67 @@ def test_wire_header_rejects_code_loading_pickles():
     frame = struct.pack("<I", len(evil)) + evil
     with pytest.raises(pickle.UnpicklingError):
         Msg.decode(frame)
+
+
+def test_tsengine_autopull_distribution():
+    """TSEngine AutoPull: with ENABLE_INTRA_TS semantics the server pushes
+    each round's fresh value to registered workers in scheduler-chosen
+    order and records throughput measurements (reference DefaultAutoPull /
+    AutoPullUpdate, kvstore_dist_server.h:1372-1395, kv_app.h:586-691)."""
+    server = GeoPSServer(port=0, num_workers=2, mode="sync",
+                         accumulate=True, auto_pull=True).start()
+    addr = ("127.0.0.1", server.port)
+    try:
+        c0 = GeoPSClient(addr, sender_id=0, auto_pull=True)
+        c1 = GeoPSClient(addr, sender_id=1, auto_pull=True)
+        c0.init("w", np.zeros(4, np.float32))
+
+        for rnd in range(1, 4):
+            c0.push_async("w", np.ones(4, np.float32))
+            c1.push_async("w", np.ones(4, np.float32))
+            # both workers receive the round's value WITHOUT pulling
+            v0 = c0.auto_pull("w", min_version=rnd, timeout=30)
+            v1 = c1.auto_pull("w", min_version=rnd, timeout=30)
+            np.testing.assert_allclose(v0, 2.0 * rnd)
+            np.testing.assert_allclose(v1, 2.0 * rnd)
+
+        # the scheduler accumulated real throughput measurements
+        measured = [t for row in server.ts_sched.A for t in row
+                    if t is not None]
+        assert measured and all(t > 0 for t in measured)
+        assert server.ts_sched.iters >= 3
+        c0.close()
+        c1.close()
+    finally:
+        server.stop()
+
+
+def test_autopull_reconnect_reclaims_slot_and_dead_client_fails_fast():
+    server = GeoPSServer(port=0, num_workers=2, mode="sync",
+                         accumulate=True, auto_pull=True).start()
+    addr = ("127.0.0.1", server.port)
+    try:
+        c0 = GeoPSClient(addr, sender_id=0, auto_pull=True)
+        c1 = GeoPSClient(addr, sender_id=1, auto_pull=True)
+        c0.init("w", np.zeros(2, np.float32))
+        c1.close()  # worker 1 dies...
+        c1b = GeoPSClient(addr, sender_id=1, auto_pull=True)  # ...restarts
+        c0.push_async("w", np.ones(2, np.float32))
+        c1b.push_async("w", np.ones(2, np.float32))
+        # the reconnected client reclaimed slot 1 and receives the round
+        np.testing.assert_allclose(
+            c1b.auto_pull("w", min_version=1, timeout=30), 2.0)
+        # a third distinct sender overflows the table with a clear error
+        with pytest.raises(RuntimeError, match="autopull table full"):
+            GeoPSClient(addr, sender_id=7, auto_pull=True)
+        c1b.close()
+    finally:
+        server.stop()
+
+    # the still-connected client's auto_pull fails fast on server death
+    # (the recv loop wakes autopull waiters) instead of burning its timeout
+    t0 = time.time()
+    with pytest.raises(ConnectionError):
+        c0.auto_pull("w", min_version=99, timeout=30)
+    assert time.time() - t0 < 10
+    c0.close()

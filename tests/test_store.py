@@ -195,3 +195,16 @@ def test_row_sparse_lazy_update_leaves_untouched_rows_alone():
     with pytest.raises(ValueError, match="row_id lists"):
         kv.push_row_sparse("emb", [np.array([0]), np.array([1])],
                            [np.ones((1, 2), np.float32)])
+
+
+def test_row_sparse_aggregation_preserves_untouched_rows():
+    """No-optimizer row-sparse pushes accumulate into the store without
+    wiping rows the push didn't mention."""
+    kv = create("local")
+    kv.init("emb", np.full((3, 2), 5.0, np.float32))
+    kv.push_row_sparse("emb", np.array([1]), np.ones((1, 2), np.float32))
+    kv.push_row_sparse("emb", np.array([2]), np.ones((1, 2), np.float32))
+    got = np.asarray(kv.pull("emb"))
+    np.testing.assert_allclose(got[0], 5.0)  # untouched
+    np.testing.assert_allclose(got[1], 6.0)  # accumulated, not replaced
+    np.testing.assert_allclose(got[2], 6.0)
