@@ -1,8 +1,14 @@
-"""Data pipeline: datasets, party/worker sharding samplers, host loader."""
+"""Data pipeline: datasets, party/worker sharding samplers, host loader,
+RecordIO packed format + prefetching record iterator."""
 
 from geomx_tpu.data.samplers import SplitSampler, ClassSplitSampler
 from geomx_tpu.data.datasets import load_dataset, DATASETS
 from geomx_tpu.data.loader import GeoDataLoader
+from geomx_tpu.data.recordio import (RecordIOReader, RecordIOWriter,
+                                     pack_labelled, unpack_labelled)
+from geomx_tpu.data.record_iter import ImageRecordIter, PrefetchIter
 
 __all__ = ["SplitSampler", "ClassSplitSampler", "load_dataset", "DATASETS",
-           "GeoDataLoader"]
+           "GeoDataLoader", "RecordIOReader", "RecordIOWriter",
+           "pack_labelled", "unpack_labelled", "ImageRecordIter",
+           "PrefetchIter"]

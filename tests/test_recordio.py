@@ -1,0 +1,134 @@
+"""RecordIO format + iterator tests (reference dmlc recordio +
+src/io image iterators; packing tool tools/im2rec.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from geomx_tpu.data import (ImageRecordIter, PrefetchIter, RecordIOReader,
+                            RecordIOWriter, pack_labelled, unpack_labelled)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_dataset(path, n=20, h=8, w=8, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = (rng.rand(n, h, w, c) * 255).astype(np.uint8)
+    ys = rng.randint(0, 10, n)
+    with RecordIOWriter(path) as wtr:
+        for img, label in zip(xs, ys):
+            wtr.write(pack_labelled(float(label), img))
+    return xs, ys
+
+
+def test_roundtrip_sequential_and_indexed(tmp_path):
+    path = str(tmp_path / "d.rec")
+    xs, ys = _write_dataset(path)
+    with RecordIOReader(path) as r:
+        # sequential scan
+        seq = [unpack_labelled(p) for p in r]
+        assert len(seq) == len(xs)
+        for (label, img), x, y in zip(seq, xs, ys):
+            assert label == y
+            np.testing.assert_array_equal(img, x)
+        # random access through the .idx sidecar
+        label, img = unpack_labelled(r.read_idx(7))
+        assert label == ys[7]
+        np.testing.assert_array_equal(img, xs[7])
+
+
+def test_corruption_detected(tmp_path):
+    path = str(tmp_path / "d.rec")
+    _write_dataset(path, n=3)
+    data = bytearray(open(path, "rb").read())
+    data[20] ^= 0xFF  # flip a payload byte of record 0
+    open(path, "wb").write(bytes(data))
+    with RecordIOReader(path) as r:
+        with pytest.raises(ValueError, match="crc"):
+            r.read_idx(0)
+
+
+def test_sharded_read_partitions_everything(tmp_path):
+    path = str(tmp_path / "d.rec")
+    xs, _ = _write_dataset(path, n=21)
+    with RecordIOReader(path) as r:
+        shards = [list(r.read_shard(i, 4)) for i in range(4)]
+    # disjoint, complete (tail goes to the last shard)
+    assert sum(len(s) for s in shards) == 21
+    assert len(shards[3]) == 6
+
+
+def test_image_record_iter_batches_and_prefetch(tmp_path):
+    path = str(tmp_path / "d.rec")
+    xs, ys = _write_dataset(path, n=32)
+    it = ImageRecordIter(path, batch_size=8, shuffle=True, seed=1)
+    batches = list(it.epoch(0))
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (8, 8, 8, 3) and xb.dtype == np.uint8
+    assert yb.shape == (8,) and yb.dtype == np.int32
+    # every sample appears exactly once across the epoch
+    seen = np.concatenate([b[1] for b in batches])
+    assert sorted(seen.tolist()) == sorted(ys.tolist())
+    it.close()
+
+
+def test_prefetch_iter_propagates_errors():
+    def boom():
+        yield 1
+        raise RuntimeError("decode failed")
+
+    it = PrefetchIter(boom(), depth=1)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
+def test_im2rec_tool_end_to_end(tmp_path):
+    out = str(tmp_path / "synth.rec")
+    proc = subprocess.run(
+        [sys.executable, "tools/im2rec.py", out,
+         "--dataset", "synthetic", "--split", "test"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    with RecordIOReader(out) as r:
+        assert len(r) > 0
+        label, img = unpack_labelled(r.read_idx(0))
+        assert img.shape == (32, 32, 3)
+
+
+def test_prefetch_exhaustion_and_early_abandon(tmp_path):
+    path = str(tmp_path / "d.rec")
+    _write_dataset(path, n=32)
+    it = ImageRecordIter(path, batch_size=4, prefetch=1)
+
+    # exhausted iterator stays exhausted (no hang on extra next())
+    ep = it.epoch(0)
+    assert len(list(ep)) == 8
+    assert next(ep, None) is None
+    assert next(ep, None) is None
+
+    # abandoning an epoch early + close() stops the pump thread
+    ep2 = it.epoch(1)
+    next(ep2)
+    it.close()
+    assert not ep2._t.is_alive()
+
+
+def test_mnist_shape_roundtrip_keeps_channel():
+    import numpy as np
+    img = np.arange(28 * 28, dtype=np.uint8).reshape(28, 28, 1)
+    label, back = unpack_labelled(pack_labelled(3.0, img))
+    assert label == 3.0
+    assert back.shape == (28, 28, 1)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_out_of_range_part_index_raises(tmp_path):
+    path = str(tmp_path / "d.rec")
+    _write_dataset(path, n=8)
+    with pytest.raises(ValueError, match="part_index"):
+        ImageRecordIter(path, batch_size=2, part_index=4, num_parts=4)

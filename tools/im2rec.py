@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Pack a dataset into RecordIO — the reference's tools/im2rec.
+
+Two sources:
+
+  # an image folder: class-per-subdirectory (requires PIL, optional)
+  python tools/im2rec.py out.rec --image-folder data/train/
+
+  # an in-repo dataset name (mnist / fashion-mnist / cifar10 / synthetic)
+  python tools/im2rec.py out.rec --dataset cifar10 [--split test]
+
+Produces ``out.rec`` + ``out.rec.idx``; read back with
+geomx_tpu.data.ImageRecordIter.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from geomx_tpu.data.recordio import RecordIOWriter, pack_labelled
+
+
+def from_dataset(name: str, split: str, root: str):
+    from geomx_tpu.data import load_dataset
+    d = load_dataset(name, root=root)
+    if split == "test":
+        return d["test_x"], d["test_y"]
+    return d["train_x"], d["train_y"]
+
+
+def from_folder(folder: str):
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise SystemExit("--image-folder needs PIL; use --dataset instead") \
+            from e
+    classes = sorted(d for d in os.listdir(folder)
+                     if os.path.isdir(os.path.join(folder, d)))
+    xs, ys = [], []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(folder, cls)
+        for fname in sorted(os.listdir(cdir)):
+            img = np.asarray(Image.open(os.path.join(cdir, fname))
+                             .convert("RGB"), np.uint8)
+            xs.append(img)
+            ys.append(label)
+    return xs, np.asarray(ys, np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("output")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--image-folder")
+    src.add_argument("--dataset",
+                     choices=["mnist", "fashion-mnist", "cifar10",
+                              "synthetic"])
+    ap.add_argument("--split", default="train", choices=["train", "test"])
+    ap.add_argument("--data-dir", default=os.environ.get("GEOMX_DATA_DIR",
+                                                         "/root/data"))
+    args = ap.parse_args()
+
+    if args.dataset:
+        xs, ys = from_dataset(args.dataset, args.split, args.data_dir)
+    else:
+        xs, ys = from_folder(args.image_folder)
+
+    with RecordIOWriter(args.output) as w:
+        for img, label in zip(xs, ys):
+            w.write(pack_labelled(float(label), img))
+    print(f"wrote {len(ys)} records to {args.output} (+ .idx)")
+
+
+if __name__ == "__main__":
+    main()
