@@ -34,7 +34,7 @@ def main():
     model = ResNet20(num_classes=10)
     trainer = Trainer(model, topo, optax.sgd(0.1, momentum=0.9), sync=FSA())
 
-    batch = int(os.environ.get("GEOMX_BENCH_BATCH", 1024))
+    batch = int(os.environ.get("GEOMX_BENCH_BATCH", 2048))
     rng = np.random.RandomState(0)
     x = (rng.rand(1, 1, batch, 32, 32, 3) * 255).astype(np.uint8)
     y = rng.randint(0, 10, size=(1, 1, batch)).astype(np.int32)

@@ -1,0 +1,134 @@
+"""High-level Module API — the ``mx.mod.Module`` surface.
+
+Reference: python/mxnet/module/ (~4000 LoC): a model + optimizer + kvstore
+bound into one object with ``fit / predict / score /
+save_checkpoint / load_checkpoint`` and epoch callbacks.  Here it is a
+thin veneer over ``Trainer`` (which already owns the jitted SPMD step),
+provided for users coming from the reference API; new code should use
+``Trainer`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from geomx_tpu import metric as metric_mod
+from geomx_tpu.config import GeoConfig
+from geomx_tpu.topology import HiPSTopology
+from geomx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+class Module:
+    def __init__(self, model: Union[str, Any],
+                 topology: Optional[HiPSTopology] = None,
+                 config: Optional[GeoConfig] = None,
+                 optimizer: Union[str, Any] = "adam",
+                 optimizer_params: Optional[dict] = None,
+                 sync: Optional[Any] = None,
+                 num_classes: int = 10):
+        from geomx_tpu.models import get_model
+        from geomx_tpu.optim import get_optimizer
+        from geomx_tpu.sync import get_sync_algorithm
+        from geomx_tpu.train import Trainer
+
+        self.config = config or GeoConfig.from_env()
+        self.topology = topology or HiPSTopology(
+            self.config.num_parties, self.config.workers_per_party)
+        if isinstance(model, str):
+            model = get_model(model, num_classes=num_classes)
+        if isinstance(optimizer, str):
+            optimizer = get_optimizer(optimizer,
+                                      **(optimizer_params or {}))
+        if sync is None:
+            sync = get_sync_algorithm(self.config)
+        self.trainer = Trainer(model, self.topology, optimizer,
+                               sync=sync, config=self.config)
+        self.state = None
+
+    # ---- binding / params (reference module.bind / get_params) -----------
+
+    def bind(self, sample_input: np.ndarray, rng: Optional[Any] = None):
+        """Initialize state from one sample batch (the reference's
+        bind+init_params collapse into one call here)."""
+        rng = jax.random.PRNGKey(0) if rng is None else rng
+        self.state = self.trainer.init_state(rng, sample_input)
+        return self
+
+    def _require_state(self):
+        if self.state is None:
+            raise RuntimeError("call bind() (or fit/load_checkpoint) first")
+
+    def get_params(self):
+        self._require_state()
+        return jax.tree.map(lambda a: np.asarray(a[0, 0]),
+                            self.state.params)
+
+    # ---- training (reference module.fit) ----------------------------------
+
+    def fit(self, train_data: Tuple[np.ndarray, np.ndarray],
+            eval_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+            num_epoch: int = 1, batch_size: int = 32,
+            eval_metric: Union[str, Sequence[str]] = "acc",
+            split_by_class: bool = False, augment: bool = False,
+            epoch_end_callback: Optional[Callable] = None,
+            verbose: bool = True):
+        x, y = train_data
+        if self.state is None:
+            self.bind(x[:2])
+        loader = self.trainer.make_loader(x, y, batch_size,
+                                          split_by_class=split_by_class,
+                                          augment=augment)
+        for epoch in range(num_epoch):
+            for xb, yb in loader.epoch(epoch):
+                self.state, m = self.trainer.train_step(self.state, xb, yb)
+                jax.device_get(m)   # host sync per step (collective safety)
+            if eval_data is not None:
+                pairs = self.score(eval_data, eval_metric)
+                if verbose:
+                    msg = " ".join(f"{n}={v:.4f}" for n, v in pairs)
+                    print(f"Epoch[{epoch}] Validation {msg}", flush=True)
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self)
+        return self
+
+    # ---- inference (reference module.predict / score) ---------------------
+
+    def predict(self, x: np.ndarray, batch_size: int = 512) -> np.ndarray:
+        """Logits for a host batch — Trainer's jitted eval path."""
+        self._require_state()
+        return self.trainer.predict_logits(self.state, np.asarray(x),
+                                           batch_size=batch_size)
+
+    def score(self, eval_data: Tuple[np.ndarray, np.ndarray],
+              eval_metric: Union[str, Sequence[str]] = "acc"):
+        """(name, value) pairs, like the reference's module.score."""
+        self._require_state()
+        m = metric_mod.create(list(eval_metric) if isinstance(
+            eval_metric, (list, tuple)) else eval_metric)
+        x, y = eval_data
+        logits = self.predict(x)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        m.update(np.asarray(y), probs)
+        return m.get_name_value()
+
+    # ---- checkpointing (reference mx.model save/load_checkpoint) ----------
+
+    def save_checkpoint(self, prefix: str, epoch: int) -> str:
+        # no step= here: that argument nests the file under a step_N
+        # directory (for periodic in-training snapshots); the epoch already
+        # names this file, reference-style (prefix-%04d)
+        self._require_state()
+        return save_checkpoint(f"{prefix}-{epoch:04d}.ckpt", self.state)
+
+    def load_checkpoint(self, prefix: str, epoch: int,
+                        sample_input: np.ndarray):
+        """Restore a checkpoint into a freshly-bound state (shapes come
+        from ``sample_input``, values from the file)."""
+        self.bind(sample_input)
+        self.state = load_checkpoint(f"{prefix}-{epoch:04d}.ckpt",
+                                     target=self.state)
+        return self

@@ -111,14 +111,20 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
 
 
 def build_eval_step(apply_fn: Callable):
-    """Single-program eval on unreplicated params (any one device)."""
+    """Single-program eval on unreplicated params (any one device).
+    Returns (eval_step, logits_fn); both share the one normalization
+    convention (uint8 -> [0,1] on device)."""
+
+    @jax.jit
+    def logits_fn(params, model_state, x):
+        x = x.astype(jnp.float32) / 255.0
+        variables = {"params": params, **model_state}
+        return apply_fn(variables, x, train=False)
 
     @jax.jit
     def eval_step(params, model_state, x, y):
-        x = x.astype(jnp.float32) / 255.0
-        variables = {"params": params, **model_state}
-        logits = apply_fn(variables, x, train=False)
+        logits = logits_fn(params, model_state, x)
         pred = jnp.argmax(logits, -1)
         return jnp.sum(pred == y), jnp.asarray(y.shape[0], jnp.int32)
 
-    return eval_step
+    return eval_step, logits_fn

@@ -43,7 +43,7 @@ class Trainer:
         self.loss_fn = make_loss_fn(model.apply)
         self.train_step = build_train_step(
             self.loss_fn, self.tx, self.sync, topology, self.mesh, donate=donate)
-        self.eval_step = build_eval_step(model.apply)
+        self.eval_step, self._logits_fn = build_eval_step(model.apply)
         self._batch_sharding = topology.batch_sharding(self.mesh)
 
     def init_state(self, rng: jax.Array, sample_input: np.ndarray) -> TrainState:
@@ -75,6 +75,24 @@ class Trainer:
         return GeoDataLoader(x, y, self.topology, batch_size,
                              split_by_class=split_by_class, seed=seed,
                              sharding=self._batch_sharding, augment=augment)
+
+    def predict_logits(self, state: TrainState, x: np.ndarray,
+                       batch_size: int = 512) -> np.ndarray:
+        """Jitted logits over a host array (one device, unreplicated
+        params); the single eval path Module.predict/score also use."""
+        params = jax.tree.map(lambda a: a[0, 0], state.params)
+        model_state = jax.tree.map(lambda a: a[0, 0], state.model_state)
+        outs = []
+        for i in range(0, len(x), batch_size):
+            xb = x[i:i + batch_size]
+            pad = batch_size - len(xb)
+            if pad:  # pad the ragged tail: one compiled shape only
+                xb = np.concatenate(
+                    [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+            logits = np.asarray(self._logits_fn(params, model_state,
+                                                jnp.asarray(xb)))
+            outs.append(logits[:batch_size - pad] if pad else logits)
+        return np.concatenate(outs) if outs else np.zeros((0,))
 
     def evaluate(self, state: TrainState, x: np.ndarray, y: np.ndarray,
                  batch_size: int = 512) -> float:
