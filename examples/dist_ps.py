@@ -49,11 +49,14 @@ GLOBAL_HOST = (env("GEOMX_PS_GLOBAL_HOST")
                or env("DMLC_PS_GLOBAL_ROOT_URI") or "127.0.0.1")
 LOCAL_HOST = env("GEOMX_PS_HOST") or env("DMLC_PS_ROOT_URI") or "127.0.0.1"
 SYNC = env("GEOMX_SYNC_MODE", "fsa")
+HFA_K2 = env("GEOMX_HFA_K2", 10, int)  # used when GEOMX_SYNC_MODE=hfa
 COMPRESSION = env("GEOMX_COMPRESSION", None)
 EPOCHS = env("GEOMX_EPOCHS", 3, int)
 BATCH = env("GEOMX_BATCH", 64, int)
 LR = env("GEOMX_LR", 0.1, float)
-MODE = "sync" if SYNC == "fsa" else "async"
+# hfa is sync intra-party with K2-periodic global relays (the server-side
+# half of HFA); mixed maps to the async server
+MODE = "async" if SYNC in ("mixed", "dist_async", "async") else "sync"
 
 
 def run_global_server():
@@ -72,7 +75,8 @@ def run_local_server():
     srv = GeoPSServer(port=port, num_workers=WORKERS_PER_PARTY, mode=MODE,
                       global_addr=(GLOBAL_HOST, GLOBAL_PORT),
                       compression=COMPRESSION, rank=1 + PARTY_ID,
-                      global_sender_id=1000 + PARTY_ID).start()
+                      global_sender_id=1000 + PARTY_ID,
+                      hfa_k2=HFA_K2 if SYNC == "hfa" else 1).start()
     print(f"[server p{PARTY_ID}] listening on {port} "
           f"({WORKERS_PER_PARTY} workers, compression={COMPRESSION})",
           flush=True)

@@ -61,6 +61,14 @@ def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
         lib.gx_ts_ask1.restype = ctypes.c_int
         lib.gx_ts_iters.argtypes = [ctypes.c_void_p]
         lib.gx_ts_iters.restype = ctypes.c_int64
+        # sgd
+        fp = ctypes.POINTER(ctypes.c_float)
+        lib.gx_sgd_update.argtypes = [fp, fp, ctypes.c_int64,
+                                      ctypes.c_float, ctypes.c_float,
+                                      ctypes.c_float]
+        lib.gx_sgd_mom_update.argtypes = [fp, fp, fp, ctypes.c_int64,
+                                          ctypes.c_float, ctypes.c_float,
+                                          ctypes.c_float, ctypes.c_float]
         _lib = lib
         return _lib
 
@@ -168,3 +176,52 @@ class NativeTSEngine:
             self._lib.gx_ts_destroy(self._ts)
         except Exception:
             pass
+
+
+class NativeSGD:
+    """C++ server-side SGD (reference src/optimizer/sgd-inl.h:40-178):
+    in-place plain / momentum updates with gradient clipping and weight
+    decay, for the host PS service's hot path — no optax/jax dispatch per
+    key per round."""
+
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+                 weight_decay: float = 0.0, clip_gradient: float = -1.0):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no toolchain?)")
+        self._lib = lib
+        self.lr = float(learning_rate)
+        self.momentum = float(momentum)
+        self.wd = float(weight_decay)
+        self.clip = float(clip_gradient)
+
+    def init_state(self, w):
+        import numpy as np
+        if self.momentum == 0.0:
+            return None
+        return np.zeros_like(np.asarray(w, np.float32))
+
+    def update(self, w, g, mom=None):
+        """In-place update of float32 arrays w (and mom); returns w."""
+        import ctypes as ct
+
+        import numpy as np
+        w = np.ascontiguousarray(w, np.float32)
+        g = np.ascontiguousarray(g, np.float32)
+        if w.shape != g.shape:
+            raise ValueError(f"shape mismatch {w.shape} vs {g.shape}")
+        fp = ct.POINTER(ct.c_float)
+        wp = w.ctypes.data_as(fp)
+        gp = g.ctypes.data_as(fp)
+        if self.momentum == 0.0:
+            self._lib.gx_sgd_update(wp, gp, w.size, self.lr, self.wd,
+                                    self.clip)
+        else:
+            if mom is None:
+                raise ValueError("momentum update needs the mom buffer")
+            mom = np.ascontiguousarray(mom, np.float32)
+            self._lib.gx_sgd_mom_update(wp, gp,
+                                        mom.ctypes.data_as(fp), w.size,
+                                        self.lr, self.momentum, self.wd,
+                                        self.clip)
+        return w

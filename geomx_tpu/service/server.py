@@ -44,6 +44,7 @@ class _KeyState:
         self.round = 0            # completed merge rounds
         self.pushed: Dict[int, int] = {}   # sender -> rounds pushed
         self.waiting_pulls = []   # (conn, rid, round_needed) until merged
+        self.hfa_acc: Optional[np.ndarray] = None  # HFA K2 accumulator
 
 
 class GeoPSServer:
@@ -60,7 +61,8 @@ class GeoPSServer:
                  rank: int = 0,
                  bind_host: Optional[str] = None,
                  auto_pull: Optional[bool] = None,
-                 max_greed_rate: Optional[float] = None):
+                 max_greed_rate: Optional[float] = None,
+                 hfa_k2: int = 1):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -68,8 +70,15 @@ class GeoPSServer:
         self.num_workers = num_workers
         self.mode = mode
         self.accumulate = accumulate
+        # HFA at the PS tier (reference kvstore_dist_server.h:988-1017,
+        # 1327-1346): a local server relays to the global tier only every
+        # K2-th completed round, accumulating the intermediate merges — the
+        # WAN-frequency reduction half of HFA (K1, the local-step period,
+        # lives in the workers' loop)
+        self.hfa_k2 = max(1, int(hfa_k2))
         self._tx = optimizer
         self._tx_config = None
+        self._native_sgd = None
         self._opt_state: Dict[str, Any] = {}
         self._store: Dict[str, _KeyState] = {}
         self._lock = threading.Lock()
@@ -249,7 +258,10 @@ class GeoPSServer:
             with self._lock:
                 if msg.key not in self._store:
                     self._store[msg.key] = _KeyState(msg.array)
-                    if self._tx is not None:
+                    if self._native_sgd is not None:
+                        self._opt_state[msg.key] = \
+                            self._native_sgd.init_state(msg.array)
+                    elif self._tx is not None:
                         self._opt_state[msg.key] = self._tx.init(msg.array)
                     if self._compressor is not None:
                         self._comp_state[msg.key] = \
@@ -334,11 +346,8 @@ class GeoPSServer:
                     # config so ordering vs. first pushes is safe in async
                     # mode; don't reset optimizer state on repeats
                     if self._tx_config != config:
-                        from geomx_tpu.optim import get_optimizer
-                        self._tx = get_optimizer(config[0], **config[1])
+                        self._set_optimizer_locked(*config)
                         self._tx_config = config
-                        for k, st in self._store.items():
-                            self._opt_state[k] = self._tx.init(st.value)
         elif cmd == "set_gradient_compression":
             from geomx_tpu.compression import get_compressor
             self._compressor = get_compressor(msg.meta["spec"])
@@ -389,10 +398,41 @@ class GeoPSServer:
 
     # ---- the data path -----------------------------------------------------
 
+    def _set_optimizer_locked(self, name: str, kwargs: dict):
+        """Install the server-side optimizer.  The sgd family goes through
+        the native C++ kernel when the runtime is built (the reference's
+        legacy server-side SGDOpt, src/optimizer/sgd-inl.h — applied
+        without a python/optax dispatch per key per round); everything
+        else is an optax transform.  GEOMX_NATIVE_SGD=0 opts out."""
+        self._native_sgd = None
+        use_native = (name in ("sgd", "momentum")
+                      and os.environ.get("GEOMX_NATIVE_SGD", "1") != "0")
+        if use_native:
+            try:
+                from geomx_tpu.runtime.native import NativeSGD
+                kw = dict(kwargs)
+                if name == "momentum":
+                    kw.setdefault("momentum", 0.9)
+                self._native_sgd = NativeSGD(**kw)
+                self._tx = None
+                for k, st in self._store.items():
+                    self._opt_state[k] = self._native_sgd.init_state(st.value)
+                return
+            except (RuntimeError, TypeError):
+                pass  # no toolchain / unsupported kwargs: optax fallback
+        from geomx_tpu.optim import get_optimizer
+        self._tx = get_optimizer(name, **kwargs)
+        for k, st in self._store.items():
+            self._opt_state[k] = self._tx.init(st.value)
+
     def _apply(self, key: str, grad: np.ndarray):
         """Merged gradient -> store (optimizer if present, else overwrite —
         the reference's ApplyUpdates, kvstore_dist_server.h:502-523)."""
         st = self._store[key]
+        if self._native_sgd is not None:
+            st.value = self._native_sgd.update(
+                st.value, grad, self._opt_state.get(key))
+            return
         if self._tx is not None:
             import jax.numpy as jnp
             import optax
@@ -521,7 +561,16 @@ class GeoPSServer:
         if st.count >= self.num_workers:
             merged, st.merged, st.count = st.merged, None, 0
             if self._global_sock is not None:
-                st.value = self._relay_to_global(key, merged)
+                if self.hfa_k2 > 1:
+                    st.hfa_acc = merged if st.hfa_acc is None \
+                        else st.hfa_acc + merged
+                    if (st.round + 1) % self.hfa_k2 == 0:
+                        st.value = self._relay_to_global(key, st.hfa_acc)
+                        st.hfa_acc = None
+                    # else: skip the WAN hop this round; workers keep the
+                    # party-local value until the next milestone sync
+                else:
+                    st.value = self._relay_to_global(key, merged)
             else:
                 self._apply(key, merged)
             st.round += 1

@@ -245,4 +245,36 @@ int64_t gx_ts_iters(void* p) {
   return ts->iters;
 }
 
+// ---------------------------------------------------------------------------
+// Server-side SGD (reference: the native legacy optimizer the PS server
+// applies without a python round-trip, src/optimizer/sgd-inl.h:40-178:
+// clip_gradient on the raw gradient, weight decay folded in, plain and
+// momentum variants).  Used by the host PS service for the hot sgd path.
+// ---------------------------------------------------------------------------
+
+static inline float gx_clipf(float g, float clip) {
+  if (clip >= 0.0f) {
+    if (g > clip) return clip;
+    if (g < -clip) return -clip;
+  }
+  return g;
+}
+
+// w -= lr * (clip(g) + wd * w)
+void gx_sgd_update(float* w, const float* g, int64_t n, float lr, float wd,
+                   float clip) {
+  for (int64_t i = 0; i < n; ++i) {
+    w[i] -= lr * (gx_clipf(g[i], clip) + wd * w[i]);
+  }
+}
+
+// mom = momentum * mom - lr * (clip(g) + wd * w); w += mom
+void gx_sgd_mom_update(float* w, const float* g, float* mom, int64_t n,
+                       float lr, float momentum, float wd, float clip) {
+  for (int64_t i = 0; i < n; ++i) {
+    mom[i] = momentum * mom[i] - lr * (gx_clipf(g[i], clip) + wd * w[i]);
+    w[i] += mom[i];
+  }
+}
+
 }  // extern "C"

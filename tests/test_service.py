@@ -293,3 +293,26 @@ def test_autopull_reconnect_reclaims_slot_and_dead_client_fails_fast():
         c0.auto_pull("w", min_version=99, timeout=30)
     assert time.time() - t0 < 10
     c0.close()
+
+
+def test_hfa_k2_reduces_global_relays():
+    """A local server with hfa_k2=2 completes 4 local rounds but crosses
+    the WAN only twice, relaying the accumulated merge (the server-side
+    K2 half of HFA, reference kvstore_dist_server.h:988-1017)."""
+    glob = GeoPSServer(port=0, num_workers=1, mode="sync",
+                       accumulate=True).start()
+    local = GeoPSServer(port=0, num_workers=1, mode="sync",
+                        global_addr=("127.0.0.1", glob.port),
+                        global_sender_id=1000, hfa_k2=2).start()
+    try:
+        c = GeoPSClient(("127.0.0.1", local.port), sender_id=0)
+        c.init("w", np.zeros(3, np.float32))
+        for _ in range(4):
+            c.push("w", np.ones(3, np.float32))
+            c.pull("w")
+        assert glob._store["w"].round == 2        # only 2 WAN crossings
+        np.testing.assert_allclose(glob._store["w"].value, 4.0)  # no loss
+        c.close()
+    finally:
+        local.stop()
+        glob.stop()
