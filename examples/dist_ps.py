@@ -49,7 +49,8 @@ GLOBAL_HOST = (env("GEOMX_PS_GLOBAL_HOST")
                or env("DMLC_PS_GLOBAL_ROOT_URI") or "127.0.0.1")
 LOCAL_HOST = env("GEOMX_PS_HOST") or env("DMLC_PS_ROOT_URI") or "127.0.0.1"
 SYNC = env("GEOMX_SYNC_MODE", "fsa")
-HFA_K2 = env("GEOMX_HFA_K2", 10, int)  # used when GEOMX_SYNC_MODE=hfa
+HFA_K1 = env("GEOMX_HFA_K1", 20, int)  # local steps per local sync
+HFA_K2 = env("GEOMX_HFA_K2", 10, int)  # local syncs per global sync
 COMPRESSION = env("GEOMX_COMPRESSION", None)
 EPOCHS = env("GEOMX_EPOCHS", 3, int)
 BATCH = env("GEOMX_BATCH", 64, int)
@@ -61,8 +62,11 @@ MODE = "async" if SYNC in ("mixed", "dist_async", "async") else "sync"
 
 def run_global_server():
     from geomx_tpu.service import GeoPSServer
+    # HFA: the global store accumulates parties' milestone deltas onto the
+    # initial params, so it always holds the authoritative model
     srv = GeoPSServer(port=GLOBAL_PORT, num_workers=NUM_PARTIES,
-                      mode=MODE, rank=0).start()
+                      mode=MODE, rank=0,
+                      accumulate=(SYNC == "hfa")).start()
     print(f"[global_server] listening on {GLOBAL_PORT} "
           f"({NUM_PARTIES} parties, {MODE})", flush=True)
     srv.join()
@@ -76,7 +80,8 @@ def run_local_server():
                       global_addr=(GLOBAL_HOST, GLOBAL_PORT),
                       compression=COMPRESSION, rank=1 + PARTY_ID,
                       global_sender_id=1000 + PARTY_ID,
-                      hfa_k2=HFA_K2 if SYNC == "hfa" else 1).start()
+                      hfa_k2=HFA_K2 if SYNC == "hfa" else None,
+                      num_global_workers=NUM_PARTIES).start()
     print(f"[server p{PARTY_ID}] listening on {port} "
           f"({WORKERS_PER_PARTY} workers, compression={COMPRESSION})",
           flush=True)
@@ -126,7 +131,9 @@ def run_worker():
     # async-mode push could reach the global tier before the optimizer
     # command and be applied as a raw overwrite.  Within a party, FIFO
     # ordering on the relay socket puts the command before any push.
-    if WORKER_ID == 0:
+    # HFA runs the optimizer in the workers (params drift between syncs,
+    # reference examples/cnn_hfa.py:108-134) — no server-side optimizer.
+    if WORKER_ID == 0 and SYNC != "hfa":
         c.set_optimizer("sgd", learning_rate=LR)
     c.barrier()
 
@@ -140,11 +147,28 @@ def run_worker():
         return jax.grad(loss_fn)(params)
 
     steps = len(x) // BATCH
+    global_step = 0
     for ep in range(EPOCHS):
         perm = np.random.RandomState(ep).permutation(len(x))
         for s in range(steps):
             idx = perm[s * BATCH:(s + 1) * BATCH]
             g = grads(params, jnp.asarray(x[idx]), jnp.asarray(y[idx]))
+            global_step += 1
+            if SYNC == "hfa":
+                # K1 local optimizer steps between local syncs; every K1
+                # steps the party averages parameters through its server
+                # (workers push params/num_local_workers, reference
+                # cnn_hfa.py:119-134), and the server crosses the WAN every
+                # K2 local syncs with the milestone delta
+                for k in params:
+                    params[k] = params[k] - LR * np.asarray(g[k])
+                if global_step % HFA_K1 == 0:
+                    for pr, k in enumerate(sorted(params)):
+                        c.push(k, params[k] / WORKERS_PER_PARTY,
+                               priority=-pr)
+                    for k in sorted(params):
+                        params[k] = c.pull(k)
+                continue
             # P3 discipline: front-layer keys get higher priority
             for pr, k in enumerate(sorted(params)):
                 c.push(k, np.asarray(g[k]), priority=-pr)
@@ -156,6 +180,15 @@ def run_worker():
         t_acc = float((np.argmax(t_logits, 1) == yt).mean())
         print(f"[worker p{PARTY_ID}w{WORKER_ID}] epoch {ep} "
               f"train_acc {acc:.3f} test_acc {t_acc:.3f}", flush=True)
+
+    if SYNC == "hfa" and global_step % HFA_K1 != 0:
+        # flush the drift accumulated since the last K1 boundary so every
+        # worker finishes on the same synced model (all workers run the
+        # same step count, so this extra round is symmetric)
+        for pr, k in enumerate(sorted(params)):
+            c.push(k, params[k] / WORKERS_PER_PARTY, priority=-pr)
+        for k in sorted(params):
+            params[k] = c.pull(k)
 
     c.barrier()
     # every worker sends kStopServer; the local server stops once all its

@@ -199,7 +199,9 @@ class NativeSGD:
         import numpy as np
         if self.momentum == 0.0:
             return None
-        return np.zeros_like(np.asarray(w, np.float32))
+        # np.zeros (not zeros_like): the buffer must be C-contiguous even
+        # when w arrived F-ordered — update() rejects anything else
+        return np.zeros(np.shape(w), np.float32)
 
     def update(self, w, g, mom=None):
         """In-place update of float32 arrays w (and mom); returns w."""
@@ -219,7 +221,13 @@ class NativeSGD:
         else:
             if mom is None:
                 raise ValueError("momentum update needs the mom buffer")
-            mom = np.ascontiguousarray(mom, np.float32)
+            # the momentum update is in place; a silent ascontiguousarray
+            # copy here would be applied to a temporary and lost
+            if not (isinstance(mom, np.ndarray) and mom.dtype == np.float32
+                    and mom.flags["C_CONTIGUOUS"]):
+                raise ValueError(
+                    "mom must be a C-contiguous float32 ndarray "
+                    "(use init_state to allocate it)")
             self._lib.gx_sgd_mom_update(wp, gp,
                                         mom.ctypes.data_as(fp), w.size,
                                         self.lr, self.momentum, self.wd,

@@ -44,7 +44,9 @@ class _KeyState:
         self.round = 0            # completed merge rounds
         self.pushed: Dict[int, int] = {}   # sender -> rounds pushed
         self.waiting_pulls = []   # (conn, rid, round_needed) until merged
-        self.hfa_acc: Optional[np.ndarray] = None  # HFA K2 accumulator
+        # HFA: last globally-agreed value (the reference's stored_milestone,
+        # kvstore_dist_server.h:988-1017)
+        self.milestone: Optional[np.ndarray] = None
 
 
 class GeoPSServer:
@@ -62,7 +64,8 @@ class GeoPSServer:
                  bind_host: Optional[str] = None,
                  auto_pull: Optional[bool] = None,
                  max_greed_rate: Optional[float] = None,
-                 hfa_k2: int = 1):
+                 hfa_k2: Optional[int] = None,
+                 num_global_workers: int = 1):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -71,11 +74,18 @@ class GeoPSServer:
         self.mode = mode
         self.accumulate = accumulate
         # HFA at the PS tier (reference kvstore_dist_server.h:988-1017,
-        # 1327-1346): a local server relays to the global tier only every
-        # K2-th completed round, accumulating the intermediate merges — the
-        # WAN-frequency reduction half of HFA (K1, the local-step period,
-        # lives in the workers' loop)
-        self.hfa_k2 = max(1, int(hfa_k2))
+        # 1327-1346): workers push party-averaged *parameters* every K1
+        # local steps; the local server applies every merge so pulls stay
+        # fresh, and only every K2-th completed round crosses the WAN,
+        # relaying the milestone delta (store - milestone)/num_global_workers
+        # — the reference's stored/stored_milestone scheme.  K1, the
+        # local-step period, lives in the workers' loop.  ``hfa_k2=None``
+        # disables HFA; any value >= 1 enables it (K2=1 still means
+        # param-push semantics, just with every local sync crossing the WAN).
+        self.hfa_k2 = None if hfa_k2 is None else max(1, int(hfa_k2))
+        # global-tier width (the reference's NumGlobalWorkers) for the HFA
+        # delta pre-division
+        self.num_global_workers = max(1, int(num_global_workers))
         self._tx = optimizer
         self._tx_config = None
         self._native_sgd = None
@@ -258,6 +268,9 @@ class GeoPSServer:
             with self._lock:
                 if msg.key not in self._store:
                     self._store[msg.key] = _KeyState(msg.array)
+                    if self.hfa_k2 is not None:
+                        self._store[msg.key].milestone = \
+                            np.asarray(msg.array, np.float32).copy()
                     if self._native_sgd is not None:
                         self._opt_state[msg.key] = \
                             self._native_sgd.init_state(msg.array)
@@ -551,8 +564,10 @@ class GeoPSServer:
             self._reply(conn, msg, Msg(MsgType.ACK, key=key))
             if self.ts_sched is not None:
                 # async intra-TS: disseminate after every apply, like the
-                # reference's TS_ApplyUpdates -> DefaultAutoPull
-                self._ap_queue.put((key, st.value, st.round))
+                # reference's TS_ApplyUpdates -> DefaultAutoPull.  Snapshot
+                # with copy(): NativeSGD mutates st.value in place, and the
+                # distributor thread serializes outside self._lock
+                self._ap_queue.put((key, st.value.copy(), st.round))
             return
         st.merged = grad if st.merged is None else st.merged + grad
         st.count += 1
@@ -561,14 +576,25 @@ class GeoPSServer:
         if st.count >= self.num_workers:
             merged, st.merged, st.count = st.merged, None, 0
             if self._global_sock is not None:
-                if self.hfa_k2 > 1:
-                    st.hfa_acc = merged if st.hfa_acc is None \
-                        else st.hfa_acc + merged
+                if self.hfa_k2 is not None:
+                    # HFA: `merged` is the party-average parameters (workers
+                    # push params/num_workers).  Apply it every round so
+                    # pulls see fresh aggregates — the reference calls
+                    # ApplyUpdates every round and skips only the WAN hop
+                    # (kvstore_dist_server.h:1326-1332)
+                    self._apply(key, merged)
                     if (st.round + 1) % self.hfa_k2 == 0:
-                        st.value = self._relay_to_global(key, st.hfa_acc)
-                        st.hfa_acc = None
-                    # else: skip the WAN hop this round; workers keep the
-                    # party-local value until the next milestone sync
+                        # milestone sync: relay the normalized delta
+                        # (kvstore_dist_server.h:1334-1338).  The global
+                        # tier runs in accumulate mode and holds the real
+                        # model (init + every synced delta), so the pull
+                        # returns authoritative params — parties whose
+                        # milestones ever disagreed reconverge here,
+                        # unlike rebasing on the local milestone
+                        delta = (st.value.astype(np.float32) - st.milestone) \
+                            / self.num_global_workers
+                        st.value = self._relay_to_global(key, delta)
+                        st.milestone = st.value.copy()
                 else:
                     st.value = self._relay_to_global(key, merged)
             else:
@@ -586,10 +612,11 @@ class GeoPSServer:
                     still.append((c, rid, need))
             st.waiting_pulls = still
             if self.ts_sched is not None:
-                # hand the snapshot to the distributor thread: blocking
-                # sends must not run under self._lock (a stalled client
-                # would freeze the whole tier)
-                self._ap_queue.put((key, st.value, st.round))
+                # hand an immutable snapshot to the distributor thread:
+                # blocking sends must not run under self._lock (a stalled
+                # client would freeze the whole tier), and NativeSGD
+                # mutates st.value in place on later rounds
+                self._ap_queue.put((key, st.value.copy(), st.round))
 
     def _autopull_loop(self):
         while self._running or not self._ap_queue.empty():

@@ -297,21 +297,32 @@ def test_autopull_reconnect_reclaims_slot_and_dead_client_fails_fast():
 
 def test_hfa_k2_reduces_global_relays():
     """A local server with hfa_k2=2 completes 4 local rounds but crosses
-    the WAN only twice, relaying the accumulated merge (the server-side
-    K2 half of HFA, reference kvstore_dist_server.h:988-1017)."""
+    the WAN only twice, and — like the reference, which calls ApplyUpdates
+    every round (kvstore_dist_server.h:1326) — workers pull the *fresh*
+    party average even on skip rounds; WAN hops carry the milestone delta
+    (kvstore_dist_server.h:988-1017, 1334-1338)."""
     glob = GeoPSServer(port=0, num_workers=1, mode="sync",
                        accumulate=True).start()
     local = GeoPSServer(port=0, num_workers=1, mode="sync",
                         global_addr=("127.0.0.1", glob.port),
-                        global_sender_id=1000, hfa_k2=2).start()
+                        global_sender_id=1000, hfa_k2=2,
+                        num_global_workers=1).start()
     try:
         c = GeoPSClient(("127.0.0.1", local.port), sender_id=0)
         c.init("w", np.zeros(3, np.float32))
-        for _ in range(4):
-            c.push("w", np.ones(3, np.float32))
-            c.pull("w")
+        for i in range(1, 5):
+            # HFA workers push party-averaged *parameters*
+            c.push("w", np.full(3, float(i), np.float32))
+            # every round — including WAN-skip rounds — the pull reflects
+            # this round's party average (ADVICE r1: value must not freeze
+            # for K2-1 rounds)
+            np.testing.assert_allclose(c.pull("w"), float(i))
         assert glob._store["w"].round == 2        # only 2 WAN crossings
-        np.testing.assert_allclose(glob._store["w"].value, 4.0)  # no loss
+        # the global store accumulated both milestone deltas onto the
+        # init: 0 + (2-0)/1 + (4-2)/1 = the authoritative params
+        np.testing.assert_allclose(glob._store["w"].value, 4.0)
+        # milestone rebased to the agreed params: no drift across parties
+        np.testing.assert_allclose(local._store["w"].milestone, 4.0)
         c.close()
     finally:
         local.stop()
