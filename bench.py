@@ -1,68 +1,409 @@
 """Benchmark: flagship ResNet-20 CIFAR10 training throughput on real TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "device", "mfu",
+   "configs": {<5 BASELINE.json configs>: {samples_per_sec, step_time_ms,
+   mfu, wire_bytes_per_step}}, "microbench": {...}, ...}
+
+Robustness: the measurement runs in a child process watched by this
+parent.  A hung TPU backend init (seen in round 1: jax.devices() never
+returned in the capture environment) or a wedged config is killed at a
+deadline and the parent still emits a parseable one-line JSON record with
+partial results and a diagnostic — never rc!=0 with no output.
 
 Baseline note: the reference publishes no benchmark tables (BASELINE.md);
-its demo hardware is a single V100-class GPU per worker.  We use an
-estimated 10_000 samples/sec for GeoMX-CUDA ResNet-20/CIFAR10 on one such
-GPU as the per-chip comparison constant, so vs_baseline > 1.0 means one
-TPU chip outruns one reference GPU.
+its demo hardware is a V100-class GPU per worker.  vs_baseline compares
+against an estimated 10_000 samples/sec for GeoMX-CUDA ResNet-20/CIFAR10
+on one such GPU, so vs_baseline > 1.0 means one TPU chip outruns one
+reference GPU.  MFU is reported alongside as the self-grounding number
+(measured model FLOPs / chip peak bf16 FLOPs).
+
+Env knobs:
+  GEOMX_BENCH_PLATFORM=cpu   debug on the host CPU (tiny shapes)
+  GEOMX_BENCH_BATCH          per-chip batch (default 2048; 256 on cpu)
+  GEOMX_BENCH_ITERS          timed iterations (default 30; 5 on cpu)
+  GEOMX_BENCH_INIT_TIMEOUT   seconds for backend init (default 300)
+  GEOMX_BENCH_TIMEOUT        total seconds budget (default 1500)
+  GEOMX_BENCH_TTA=1          also run time-to-accuracy (CIFAR10 if
+                             present under GEOMX_DATA_DIR, else synthetic)
+  GEOMX_BENCH_TTA_TARGET     test-acc target (default 0.92 real / 0.70 syn)
 """
 
 import json
+import os
+import queue
+import subprocess
+import sys
+import threading
 import time
 
-import numpy as np
-
 REFERENCE_GPU_SAMPLES_PER_SEC = 10_000.0
+METRIC = "resnet20_cifar10_train_samples_per_sec_per_chip"
+
+# peak dense bf16 FLOP/s per chip by device_kind substring (public specs)
+PEAK_BF16 = [
+    ("v6", 918e12),        # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),        # v5e reports "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
 
 
-def main():
-    import os
+def _peak_flops(device_kind: str):
+    dk = device_kind.lower()
+    for sub, peak in PEAK_BF16:
+        if sub in dk:
+            return peak
+    return None
 
+
+# --------------------------------------------------------------------------
+# child: owns the JAX backend, emits JSON events on stdout
+# --------------------------------------------------------------------------
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def _build_configs(n_devices: int):
+    """The five BASELINE.json configs as (name, GeoConfig overrides,
+    num_parties).  On one chip both mesh axes collapse to 1 and the
+    collective short-circuits, so the configs measure the compression /
+    sync compute the chip pays; on >=2 devices the dc tier is real."""
+    parties = 2 if n_devices >= 2 and n_devices % 2 == 0 else 1
+    return [
+        # examples/cnn.py — vanilla, single-worker local kvstore
+        ("vanilla_local", {"sync_mode": "fsa", "compression": "none"}, 1),
+        # examples/cnn.py dist_sync HiPS
+        ("dist_sync_hips", {"sync_mode": "fsa", "compression": "none"}, parties),
+        # examples/cnn_bsc.py — Bi-Sparse over HiPS
+        ("bsc", {"sync_mode": "fsa", "compression": "bsc,0.01"}, parties),
+        # examples/cnn_fp16.py / cnn_mpq.py — fp16 / mixed-precision comm
+        ("fp16_mpq", {"sync_mode": "fsa", "compression": "mpq,0.01"}, parties),
+        # examples/cnn_hfa.py — HFA + DGT priority transport
+        ("hfa_dgt", {"sync_mode": "hfa", "hfa_k1": 20, "hfa_k2": 10,
+                     "enable_dgt": 2, "compression": "none"}, parties),
+    ]
+
+
+def _measure_config(name, overrides, parties, batch, iters, peak):
     import jax
-    if os.environ.get("GEOMX_BENCH_PLATFORM"):  # debug: e.g. "cpu"
-        jax.config.update("jax_platforms", os.environ["GEOMX_BENCH_PLATFORM"])
+    import numpy as np
     import optax
 
+    from geomx_tpu.config import GeoConfig
     from geomx_tpu.models import ResNet20
-    from geomx_tpu.sync import FSA
+    from geomx_tpu.sync import get_sync_algorithm
     from geomx_tpu.topology import HiPSTopology
     from geomx_tpu.train import Trainer
 
-    topo = HiPSTopology(num_parties=1, workers_per_party=1)
-    model = ResNet20(num_classes=10)
-    trainer = Trainer(model, topo, optax.sgd(0.1, momentum=0.9), sync=FSA())
+    n_dev = jax.device_count()
+    parties = min(parties, n_dev)
+    workers = max(1, n_dev // parties) if n_dev >= parties else 1
+    topo = HiPSTopology(num_parties=parties, workers_per_party=workers)
+    cfg = GeoConfig.from_env(num_parties=parties, workers_per_party=workers,
+                             **overrides)
+    sync = get_sync_algorithm(cfg)
+    trainer = Trainer(ResNet20(num_classes=10), topo,
+                      optax.sgd(0.1, momentum=0.9), sync=sync, config=cfg)
 
-    batch = int(os.environ.get("GEOMX_BENCH_BATCH", 2048))
+    local_b = batch // (parties * workers)
     rng = np.random.RandomState(0)
-    x = (rng.rand(1, 1, batch, 32, 32, 3) * 255).astype(np.uint8)
-    y = rng.randint(0, 10, size=(1, 1, batch)).astype(np.int32)
+    x = (rng.rand(parties, workers, local_b, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(parties, workers, local_b)).astype(np.int32)
     sharding = topo.batch_sharding(trainer.mesh)
     xb = jax.device_put(x, sharding)
     yb = jax.device_put(y, sharding)
 
     state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
 
-    # warmup / compile
+    # compile once, reuse the executable (also the FLOPs source)
+    lowered = trainer.train_step.lower(state, xb, yb)
+    compiled = lowered.compile()
+    flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
     for _ in range(3):
-        state, metrics = trainer.train_step(state, xb, yb)
+        state, metrics = compiled(state, xb, yb)
     jax.block_until_ready(metrics["loss"])
 
-    iters = 30
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, metrics = trainer.train_step(state, xb, yb)
+        state, metrics = compiled(state, xb, yb)
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
 
-    sps = batch * iters / dt
-    print(json.dumps({
-        "metric": "resnet20_cifar10_train_samples_per_sec_per_chip",
-        "value": round(sps, 1),
+    step_s = dt / iters
+    sps_chip = batch * iters / dt / max(1, n_dev if parties * workers > 1 else 1)
+    mfu = None
+    if flops and peak:
+        mfu = flops / step_s / peak
+
+    # cross-dc wire accounting: what the dc-tier compressor puts on the
+    # WAN per sync, vs dense fp32 (the claim BENCH verifies in-graph via
+    # tests/test_wire_volume.py)
+    wire = None
+    comp = getattr(sync, "dc_compressor", None)
+    if comp is not None:
+        params = jax.tree.map(lambda a: a[0, 0], state.params)
+        wire = {"compressed": int(comp.wire_bytes(params)),
+                "dense_fp32": int(sum(l.size * 4
+                                      for l in jax.tree.leaves(params)))}
+
+    return {
+        "config": name,
+        "topology": f"{parties}x{workers}",
+        "batch": batch,
+        "samples_per_sec_per_chip": round(sps_chip, 1),
+        "step_time_ms": round(step_s * 1e3, 3),
+        "flops_per_step": flops,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "wire_bytes_per_sync": wire,
+    }
+
+
+def _microbench_kernels(peak, on_tpu: bool):
+    """Compression-kernel microbench: Pallas vs jnp 2-bit quantize, exact
+    vs approx BSC top-k (VERDICT r1 #7: prove the Pallas path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = 4 * 1024 * 1024
+    g = jnp.asarray(np.random.RandomState(0).randn(n), jnp.float32)
+    res = jnp.zeros((n,), jnp.float32)
+    out = {}
+
+    def _time(fn, *args, iters=20):
+        r = jax.block_until_ready(fn(*args))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters
+
+    from geomx_tpu.compression.twobit import TwoBitCompressor
+    jnp_q = jax.jit(TwoBitCompressor(0.5, use_pallas=False).quantize)
+    out["twobit_jnp_ms"] = round(_time(jnp_q, g, res) * 1e3, 4)
+    if on_tpu:
+        try:
+            from geomx_tpu.ops import quantize_2bit
+            pl_q = jax.jit(lambda a, b: quantize_2bit(a, b, 0.5))
+            out["twobit_pallas_ms"] = round(_time(pl_q, g, res) * 1e3, 4)
+        except Exception as e:
+            out["twobit_pallas_error"] = repr(e)
+
+    k = n // 100
+    topk = jax.jit(lambda v: jax.lax.top_k(jnp.abs(v), k))
+    out["bsc_topk_exact_ms"] = round(_time(topk, g) * 1e3, 4)
+    atopk = jax.jit(lambda v: jax.lax.approx_max_k(jnp.abs(v), k))
+    out["bsc_topk_approx_ms"] = round(_time(atopk, g) * 1e3, 4)
+    return out
+
+
+def _time_to_accuracy(batch):
+    """Train the flagship to the target test accuracy; wall-clock seconds.
+    Uses real CIFAR10 when present under GEOMX_DATA_DIR, else the
+    learnable synthetic set (recorded in the result)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.data import load_dataset
+    from geomx_tpu.models import ResNet20
+    from geomx_tpu.sync import FSA
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    data = load_dataset("cifar10", root=os.environ.get("GEOMX_DATA_DIR",
+                                                       "/root/data"),
+                        synthetic_train_n=8192)
+    synthetic = data["synthetic"]
+    target = float(os.environ.get("GEOMX_BENCH_TTA_TARGET",
+                                  "0.70" if synthetic else "0.92"))
+    max_epochs = int(os.environ.get("GEOMX_BENCH_TTA_EPOCHS", "40"))
+
+    topo = HiPSTopology.from_devices()
+    trainer = Trainer(ResNet20(num_classes=10), topo,
+                      optax.sgd(0.1, momentum=0.9), sync=FSA())
+    local_b = max(8, batch // topo.total_workers)
+    loader = trainer.make_loader(data["train_x"], data["train_y"], local_b,
+                                 augment=not synthetic)
+    state = trainer.init_state(jax.random.PRNGKey(0),
+                               data["train_x"][:2])
+    t0 = time.perf_counter()
+    best = 0.0
+    for ep in range(max_epochs):
+        for xb, yb in loader.epoch(ep):
+            state, metrics = trainer.train_step(state, xb, yb)
+            jax.device_get(metrics["loss"])
+        acc = trainer.evaluate(state, data["test_x"], data["test_y"])
+        best = max(best, acc)
+        if acc >= target:
+            return {"dataset": "synthetic" if synthetic else "cifar10",
+                    "target": target, "reached": True, "epochs": ep + 1,
+                    "seconds": round(time.perf_counter() - t0, 2),
+                    "test_acc": round(acc, 4)}
+    return {"dataset": "synthetic" if synthetic else "cifar10",
+            "target": target, "reached": False, "epochs": max_epochs,
+            "seconds": round(time.perf_counter() - t0, 2),
+            "test_acc": round(best, 4)}
+
+
+def child_main():
+    platform = os.environ.get("GEOMX_BENCH_PLATFORM")
+    import jax
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
+    kind = devs[0].device_kind
+    peak = _peak_flops(kind) if on_tpu else None
+    _emit({"event": "backend_up", "platform": devs[0].platform,
+           "device_kind": kind, "n_devices": len(devs),
+           "peak_bf16_flops": peak})
+
+    batch = int(os.environ.get("GEOMX_BENCH_BATCH",
+                               2048 if on_tpu else 256))
+    iters = int(os.environ.get("GEOMX_BENCH_ITERS", 30 if on_tpu else 5))
+
+    for name, overrides, parties in _build_configs(len(devs)):
+        try:
+            _emit({"event": "config",
+                   **_measure_config(name, overrides, parties, batch,
+                                     iters, peak)})
+        except Exception as e:
+            _emit({"event": "config", "config": name, "error": repr(e)})
+
+    try:
+        _emit({"event": "microbench",
+               **_microbench_kernels(peak, on_tpu)})
+    except Exception as e:
+        _emit({"event": "microbench", "error": repr(e)})
+
+    if os.environ.get("GEOMX_BENCH_TTA") == "1":
+        try:
+            _emit({"event": "tta", **_time_to_accuracy(batch)})
+        except Exception as e:
+            _emit({"event": "tta", "error": repr(e)})
+
+    _emit({"event": "done"})
+
+
+# --------------------------------------------------------------------------
+# parent: watchdog + single-line aggregation
+# --------------------------------------------------------------------------
+
+def _drain(pipe, q):
+    for line in iter(pipe.readline, ""):
+        q.put(line)
+    q.put(None)
+
+
+def parent_main():
+    init_timeout = float(os.environ.get("GEOMX_BENCH_INIT_TIMEOUT", "300"))
+    total_timeout = float(os.environ.get("GEOMX_BENCH_TIMEOUT", "1500"))
+
+    env = dict(os.environ, GEOMX_BENCH_CHILD="1")
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    q: "queue.Queue" = queue.Queue()
+    threading.Thread(target=_drain, args=(proc.stdout, q),
+                     daemon=True).start()
+    stderr_buf = []
+    threading.Thread(target=lambda: stderr_buf.extend(
+        proc.stderr.read().splitlines()[-20:]), daemon=True).start()
+
+    t_start = time.monotonic()
+    backend = None
+    configs = {}
+    microbench = None
+    tta = None
+    error = None
+    done = False
+
+    while True:
+        if backend is None:
+            deadline = t_start + init_timeout
+            phase = "backend init"
+        else:
+            deadline = t_start + total_timeout
+            phase = "measurement"
+        try:
+            line = q.get(timeout=max(0.1, deadline - time.monotonic()))
+        except queue.Empty:
+            error = (f"watchdog: {phase} exceeded "
+                     f"{init_timeout if backend is None else total_timeout:g}s"
+                     " — TPU backend hung or config wedged")
+            proc.kill()
+            break
+        if line is None:  # child exited
+            if not done and error is None and proc.poll() not in (0, None):
+                error = f"bench child exited rc={proc.poll()}"
+            break
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        kind = ev.pop("event", None)
+        if kind == "backend_up":
+            backend = ev
+        elif kind == "config":
+            configs[ev.pop("config", f"config{len(configs)}")] = ev
+        elif kind == "microbench":
+            microbench = ev
+        elif kind == "tta":
+            tta = ev
+        elif kind == "done":
+            done = True
+
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+    headline = configs.get("vanilla_local") or next(
+        (c for c in configs.values() if "samples_per_sec_per_chip" in c), None)
+    value = (headline or {}).get("samples_per_sec_per_chip") or 0.0
+    out = {
+        "metric": METRIC,
+        "value": value,
         "unit": "samples/sec",
-        "vs_baseline": round(sps / REFERENCE_GPU_SAMPLES_PER_SEC, 3),
-    }))
+        "vs_baseline": round(value / REFERENCE_GPU_SAMPLES_PER_SEC, 3),
+        "baseline_note": ("reference publishes no numbers (BASELINE.md); "
+                          "10k samples/sec is our documented estimate for "
+                          "its V100-class demo GPU"),
+        "device": backend,
+        "mfu": (headline or {}).get("mfu"),
+        "configs": configs,
+        "microbench": microbench,
+    }
+    if tta is not None:
+        out["time_to_accuracy"] = tta
+    if error is not None:
+        out["error"] = error
+        if stderr_buf:
+            out["error_detail"] = " | ".join(stderr_buf[-5:])[-2000:]
+    print(json.dumps(out))
+
+
+def main():
+    if os.environ.get("GEOMX_BENCH_CHILD") == "1":
+        child_main()
+    else:
+        parent_main()
 
 
 if __name__ == "__main__":

@@ -60,24 +60,67 @@ def partition(sizes: Sequence[int], num_servers: int,
     return out
 
 
-def sharded_update_leaf(g: jax.Array, apply_update, axis_name: str,
-                        axis_size: int, axis_index: jax.Array):
-    """ZeRO-1 building block for one big leaf, called inside shard_map.
+class MultiGPSPlan:
+    """ZeRO-1 sharded-update plan over the worker (ICI) mesh axis.
 
-    ``apply_update(shard_grad, shard_slice_start, shard_len) -> new_shard``
-    performs the optimizer math on this slot's shard.  Returns the fully
-    gathered updated tensor.
+    Consumed by ``train.step.build_train_step`` when ``config.multi_gps``
+    is set: leaves with >= ``bigarray_bound`` elements are updated
+    shard-wise — gradient ``psum_scatter`` over the worker axis, optimizer
+    math on the local 1/W shard (optimizer state allocated shard-shaped),
+    parameter ``all_gather`` back — while small leaves stay replicated.
+    The dc-tier (WAN) collective also moves only the 1/W shard of big
+    leaves, so DCN volume drops by W as well.
+
+    Semantics note: leaf-wise optimizers (SGD/momentum/Adam/...) are
+    bit-identical to the unsharded update; optimizers coupling across a
+    whole tensor (e.g. global-norm clipping) would see per-shard statistics
+    — the same per-server semantics the reference's MultiGPS has
+    (optimizer runs independently on each global server's key range,
+    kvstore_dist_server.h:1786-1826).
     """
-    n = g.size
-    shard = n // axis_size
-    flat = g.reshape(-1)
-    # pad the ragged tail onto the last shard via a second pass
-    scattered = lax.psum_scatter(flat[:shard * axis_size].reshape(axis_size, shard),
-                                 axis_name, scatter_dimension=0, tiled=False)
-    new_shard = apply_update(scattered, axis_index * shard, shard)
-    gathered = lax.all_gather(new_shard, axis_name).reshape(-1)
-    if shard * axis_size < n:
-        tail = lax.psum(flat[shard * axis_size:], axis_name)
-        tail = apply_update(tail, shard * axis_size, n - shard * axis_size)
-        gathered = jnp.concatenate([gathered, tail])
-    return gathered.reshape(g.shape)
+
+    def __init__(self, bigarray_bound: int, workers_per_party: int):
+        self.bound = int(bigarray_bound)
+        self.W = int(workers_per_party)
+
+    def is_big(self, n: int) -> bool:
+        return self.W > 1 and n >= self.bound
+
+    def shard_len(self, n: int) -> int:
+        return -(-n // self.W)
+
+    def mixed_example(self, tree: Any) -> Any:
+        """Host-side mixed view for state inits: big leaves -> a zero
+        [shard_len] float32 leaf (optimizer/compressor state is allocated
+        per shard — the 1/W memory saving), small leaves unchanged."""
+        def f(leaf):
+            leaf = jnp.asarray(leaf)
+            if self.is_big(leaf.size):
+                return jnp.zeros((self.shard_len(leaf.size),), jnp.float32)
+            return leaf
+        return jax.tree.map(f, tree)
+
+    # ---- inside shard_map ------------------------------------------------
+
+    def scatter_grad_leaf(self, g: jax.Array, axis_name: str) -> jax.Array:
+        """Worker-tier reduce for a big leaf: mean-psum_scatter, each slot
+        keeps its contiguous shard (= one global server's key range)."""
+        n = g.size
+        s = self.shard_len(n)
+        gf = jnp.zeros((s * self.W,), jnp.float32).at[:n].set(
+            g.reshape(-1).astype(jnp.float32))
+        return lax.psum_scatter(gf.reshape(self.W, s), axis_name,
+                                scatter_dimension=0) / self.W
+
+    def shard_param_leaf(self, p: jax.Array, widx: jax.Array) -> jax.Array:
+        """This slot's contiguous parameter shard (zero-padded tail)."""
+        n = p.size
+        s = self.shard_len(n)
+        pf = jnp.zeros((s * self.W,), p.dtype).at[:n].set(p.reshape(-1))
+        return lax.dynamic_slice(pf, (widx * s,), (s,))
+
+    def unshard_param_leaf(self, new_shard: jax.Array, like: jax.Array,
+                           axis_name: str) -> jax.Array:
+        """all_gather the updated shards back into the full tensor."""
+        full = lax.all_gather(new_shard, axis_name).reshape(-1)[:like.size]
+        return full.reshape(like.shape).astype(like.dtype)

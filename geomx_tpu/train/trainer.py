@@ -42,7 +42,13 @@ class Trainer:
         self.tx = optimizer
         self.loss_fn = make_loss_fn(model.apply)
         self.train_step = build_train_step(
-            self.loss_fn, self.tx, self.sync, topology, self.mesh, donate=donate)
+            self.loss_fn, self.tx, self.sync, topology, self.mesh,
+            donate=donate, config=self.config)
+        self._mgps = None
+        if self.config.multi_gps:
+            from geomx_tpu.parallel.multigps import MultiGPSPlan
+            self._mgps = MultiGPSPlan(self.config.bigarray_bound,
+                                      topology.workers_per_party)
         self.eval_step, self._logits_fn = build_eval_step(model.apply)
         self._batch_sharding = topology.batch_sharding(self.mesh)
 
@@ -56,8 +62,16 @@ class Trainer:
         variables = dict(variables)
         params = variables.pop("params")
         model_state = variables  # batch_stats etc.
-        opt_state = self.tx.init(params)
-        sync_state = self.sync.init_state(params)
+        if self._mgps is not None:
+            # MultiGPS ZeRO-1: optimizer + compressor state for big leaves
+            # is allocated per worker-axis shard (the 1/W memory saving);
+            # every (dc, worker) slot then tracks only its own shard
+            mixed = self._mgps.mixed_example(params)
+            opt_state = self.tx.init(mixed)
+            sync_state = self.sync.init_state(mixed)
+        else:
+            opt_state = self.tx.init(params)
+            sync_state = self.sync.init_state(params)
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params, opt_state=opt_state,
