@@ -9,7 +9,10 @@ Robustness: the measurement runs in a child process watched by this
 parent.  A hung TPU backend init (seen in round 1: jax.devices() never
 returned in the capture environment) or a wedged config is killed at a
 deadline and the parent still emits a parseable one-line JSON record with
-partial results and a diagnostic — never rc!=0 with no output.
+partial results and a diagnostic — never rc!=0 with no output.  Backend
+init is retried in FRESH child processes (GEOMX_BENCH_INIT_ATTEMPTS,
+default 3, with backoff) because a wedged TPU runtime can only be shaken
+loose by a new process; each attempt's failure reason is recorded.
 
 Baseline note: the reference publishes no benchmark tables (BASELINE.md);
 its demo hardware is a V100-class GPU per worker.  vs_baseline compares
@@ -22,8 +25,11 @@ Env knobs:
   GEOMX_BENCH_PLATFORM=cpu   debug on the host CPU (tiny shapes)
   GEOMX_BENCH_BATCH          per-chip batch (default 2048; 256 on cpu)
   GEOMX_BENCH_ITERS          timed iterations (default 30; 5 on cpu)
-  GEOMX_BENCH_INIT_TIMEOUT   seconds for backend init (default 300)
-  GEOMX_BENCH_TIMEOUT        total seconds budget (default 1500)
+  GEOMX_BENCH_INIT_TIMEOUT   seconds for backend init, per attempt
+                             (default 900)
+  GEOMX_BENCH_INIT_ATTEMPTS  fresh-child init attempts (default 3)
+  GEOMX_BENCH_TIMEOUT        seconds for measurement after init
+                             (default 3000)
   GEOMX_BENCH_TTA=1          also run time-to-accuracy (CIFAR10 if
                              present under GEOMX_DATA_DIR, else synthetic)
   GEOMX_BENCH_TTA_TARGET     test-acc target (default 0.92 real / 0.70 syn)
@@ -236,15 +242,15 @@ def _time_to_accuracy(batch):
                       optax.sgd(0.1, momentum=0.9), sync=FSA())
     local_b = max(8, batch // topo.total_workers)
     loader = trainer.make_loader(data["train_x"], data["train_y"], local_b,
-                                 augment=not synthetic)
+                                 augment=not synthetic, device_cache=True)
     state = trainer.init_state(jax.random.PRNGKey(0),
                                data["train_x"][:2])
+    run = trainer._epoch_runner(loader)
     t0 = time.perf_counter()
     best = 0.0
     for ep in range(max_epochs):
-        for xb, yb in loader.epoch(ep):
-            state, metrics = trainer.train_step(state, xb, yb)
-            jax.device_get(metrics["loss"])
+        sel, key = loader.epoch_indices(ep)
+        state, _ = run(state, loader._dev_x, loader._dev_y, sel, key)
         acc = trainer.evaluate(state, data["test_x"], data["test_y"])
         best = max(best, acc)
         if acc >= target:
@@ -256,6 +262,42 @@ def _time_to_accuracy(batch):
             "target": target, "reached": False, "epochs": max_epochs,
             "seconds": round(time.perf_counter() - t0, 2),
             "test_acc": round(best, 4)}
+
+
+def _fit_overhead(batch, iters, bare_sps):
+    """Measure the Trainer.fit loop (device-cached loader + scanned
+    epochs) against the bare compiled-step loop: VERDICT r2 #2's
+    criterion is fit within 10% of bare."""
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.models import ResNet20
+    from geomx_tpu.sync import FSA
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    topo = HiPSTopology(num_parties=1, workers_per_party=1)
+    trainer = Trainer(ResNet20(num_classes=10), topo,
+                      optax.sgd(0.1, momentum=0.9), sync=FSA())
+    rng = np.random.RandomState(0)
+    n = batch * max(4, iters // 2)
+    x = (rng.rand(n, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(n,)).astype(np.int32)
+    loader = trainer.make_loader(x, y, batch, device_cache=True)
+    state = trainer.init_state(jax.random.PRNGKey(0), x[:2])
+    # two warm epochs: compile, then the donated-layout fixed point
+    state, _ = trainer.fit(state, loader, epochs=2, scan_epochs=True)
+    t0 = time.perf_counter()
+    state, _ = trainer.fit(state, loader, epochs=1, scan_epochs=True)
+    jax.block_until_ready(state.step)
+    dt = time.perf_counter() - t0
+    sps = loader.steps_per_epoch * batch / dt
+    out = {"samples_per_sec": round(sps, 1),
+           "steps": loader.steps_per_epoch}
+    if bare_sps:
+        out["vs_bare_compiled"] = round(sps / bare_sps, 4)
+    return out
 
 
 def child_main():
@@ -275,13 +317,21 @@ def child_main():
                                2048 if on_tpu else 256))
     iters = int(os.environ.get("GEOMX_BENCH_ITERS", 30 if on_tpu else 5))
 
+    bare_sps = None
     for name, overrides, parties in _build_configs(len(devs)):
         try:
-            _emit({"event": "config",
-                   **_measure_config(name, overrides, parties, batch,
-                                     iters, peak)})
+            rec = _measure_config(name, overrides, parties, batch,
+                                  iters, peak)
+            if name == "vanilla_local":
+                bare_sps = rec.get("samples_per_sec_per_chip")
+            _emit({"event": "config", **rec})
         except Exception as e:
             _emit({"event": "config", "config": name, "error": repr(e)})
+
+    try:
+        _emit({"event": "fit_loop", **_fit_overhead(batch, iters, bare_sps)})
+    except Exception as e:
+        _emit({"event": "fit_loop", "error": repr(e)})
 
     try:
         _emit({"event": "microbench",
@@ -308,10 +358,10 @@ def _drain(pipe, q):
     q.put(None)
 
 
-def parent_main():
-    init_timeout = float(os.environ.get("GEOMX_BENCH_INIT_TIMEOUT", "300"))
-    total_timeout = float(os.environ.get("GEOMX_BENCH_TIMEOUT", "1500"))
-
+def _run_attempt(init_timeout, total_timeout, results):
+    """Spawn one fresh bench child; fill `results` from its event stream.
+    Returns (init_ok, error): init_ok False means the backend never came
+    up in this child (worth retrying in a new process)."""
     env = dict(os.environ, GEOMX_BENCH_CHILD="1")
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             env=env, stdout=subprocess.PIPE,
@@ -324,26 +374,22 @@ def parent_main():
         proc.stderr.read().splitlines()[-20:]), daemon=True).start()
 
     t_start = time.monotonic()
-    backend = None
-    configs = {}
-    microbench = None
-    tta = None
+    t_backend = None
     error = None
     done = False
 
     while True:
-        if backend is None:
+        if t_backend is None:
             deadline = t_start + init_timeout
-            phase = "backend init"
+            phase, budget = "backend init", init_timeout
         else:
-            deadline = t_start + total_timeout
-            phase = "measurement"
+            deadline = t_backend + total_timeout
+            phase, budget = "measurement", total_timeout
         try:
             line = q.get(timeout=max(0.1, deadline - time.monotonic()))
         except queue.Empty:
-            error = (f"watchdog: {phase} exceeded "
-                     f"{init_timeout if backend is None else total_timeout:g}s"
-                     " — TPU backend hung or config wedged")
+            error = (f"watchdog: {phase} exceeded {budget:g}s — "
+                     "TPU backend hung or config wedged")
             proc.kill()
             break
         if line is None:  # child exited
@@ -359,13 +405,17 @@ def parent_main():
             continue
         kind = ev.pop("event", None)
         if kind == "backend_up":
-            backend = ev
+            t_backend = time.monotonic()
+            results["backend"] = ev
         elif kind == "config":
-            configs[ev.pop("config", f"config{len(configs)}")] = ev
+            results["configs"][ev.pop("config",
+                                      f"config{len(results['configs'])}")] = ev
+        elif kind == "fit_loop":
+            results["fit_loop"] = ev
         elif kind == "microbench":
-            microbench = ev
+            results["microbench"] = ev
         elif kind == "tta":
-            tta = ev
+            results["tta"] = ev
         elif kind == "done":
             done = True
 
@@ -373,6 +423,33 @@ def parent_main():
         proc.wait(timeout=10)
     except subprocess.TimeoutExpired:
         proc.kill()
+    if error is not None and stderr_buf:
+        error += " | " + " | ".join(stderr_buf[-5:])[-2000:]
+    return t_backend is not None, error
+
+
+def parent_main():
+    init_timeout = float(os.environ.get("GEOMX_BENCH_INIT_TIMEOUT", "900"))
+    total_timeout = float(os.environ.get("GEOMX_BENCH_TIMEOUT", "3000"))
+    attempts = int(os.environ.get("GEOMX_BENCH_INIT_ATTEMPTS", "3"))
+
+    results = {"configs": {}, "backend": None, "fit_loop": None,
+               "microbench": None, "tta": None}
+    attempt_log = []
+    error = None
+    for i in range(max(1, attempts)):
+        init_ok, error = _run_attempt(init_timeout, total_timeout, results)
+        attempt_log.append({"attempt": i + 1, "init_ok": init_ok,
+                            "error": error})
+        if init_ok:  # measurement ran (even if partially) — don't redo
+            break
+        if i + 1 < attempts:  # backoff before a fresh child
+            time.sleep(min(60.0, 5.0 * (i + 1)))
+
+    backend = results["backend"]
+    configs = results["configs"]
+    microbench = results["microbench"]
+    tta = results["tta"]
 
     headline = configs.get("vanilla_local") or next(
         (c for c in configs.values() if "samples_per_sec_per_chip" in c), None)
@@ -388,14 +465,15 @@ def parent_main():
         "device": backend,
         "mfu": (headline or {}).get("mfu"),
         "configs": configs,
+        "fit_loop": results["fit_loop"],
         "microbench": microbench,
     }
     if tta is not None:
         out["time_to_accuracy"] = tta
     if error is not None:
         out["error"] = error
-        if stderr_buf:
-            out["error_detail"] = " | ".join(stderr_buf[-5:])[-2000:]
+    if len(attempt_log) > 1 or error is not None:
+        out["init_attempts"] = attempt_log
     print(json.dumps(out))
 
 

@@ -23,6 +23,11 @@ import numpy as np
 _LANES = 128
 _PACK = 16
 _BLOCK_COLS = _PACK * _LANES  # 2048 fp32 elements -> 128 packed int32
+# Rows per grid step.  256 rows keeps the kernel's resident blocks
+# (g, r, newr at 2 MB each + packed at 128 KB) ~6.3 MB, comfortably under
+# the 16 MB scoped-vmem limit that a gridless call blows through at
+# multi-million-element inputs (observed on v5e at 4M elements).
+_BLOCK_ROWS = 256
 
 
 def pallas_supported() -> bool:
@@ -32,10 +37,7 @@ def pallas_supported() -> bool:
         return False
 
 
-def _kernel(g_ref, r_ref, thr_ref, packed_ref, newr_ref):
-    from jax.experimental import pallas as pl  # noqa: F401
-
-    thr = thr_ref[0]
+def _kernel(thr, g_ref, r_ref, packed_ref, newr_ref):
     acc = g_ref[:] + r_ref[:]
     pos = acc >= thr
     neg = acc <= -thr
@@ -49,8 +51,7 @@ def _kernel(g_ref, r_ref, thr_ref, packed_ref, newr_ref):
     packed_ref[:] = jnp.sum(c3 << shifts, axis=1, dtype=jnp.int32)
 
 
-def _dequant_kernel(packed_ref, thr_ref, out_ref):
-    thr = thr_ref[0]
+def _dequant_kernel(thr, packed_ref, out_ref):
     rows = packed_ref.shape[0]
     shifts = (jnp.arange(_PACK, dtype=jnp.int32) * 2).reshape(1, _PACK, 1)
     codes = (packed_ref[:].reshape(rows, 1, _LANES) >> shifts) & 3
@@ -58,13 +59,25 @@ def _dequant_kernel(packed_ref, thr_ref, out_ref):
     out_ref[:] = vals.reshape(rows, _PACK * _LANES).astype(jnp.float32)
 
 
+def _block_rows(rows: int) -> int:
+    """Rows per grid step: capped at _BLOCK_ROWS for the vmem bound, but
+    no larger than the tensor needs — a 1-row bias leaf must not be
+    padded out to a 256-row block (rows is static under jit)."""
+    return min(_BLOCK_ROWS, rows)
+
+
 def _pad_to_block(x: jax.Array):
+    """Pad flat x to [rows_padded, 2048] where rows_padded is a multiple of
+    the grid's row block (so every grid step sees a full block); returns the
+    true row count so callers can strip the padding from outputs."""
     n = x.shape[0]
     rows = max(1, -(-n // _BLOCK_COLS))
-    padded = rows * _BLOCK_COLS
+    br = _block_rows(rows)
+    rows_padded = -(-rows // br) * br
+    padded = rows_padded * _BLOCK_COLS
     if padded != n:
         x = jnp.concatenate([x, jnp.zeros((padded - n,), x.dtype)])
-    return x.reshape(rows, _BLOCK_COLS), n
+    return x.reshape(rows_padded, _BLOCK_COLS), n, rows
 
 
 @functools.partial(jax.jit, static_argnames=("threshold", "interpret"))
@@ -75,17 +88,23 @@ def quantize_2bit(g: jax.Array, residual: jax.Array, threshold: float,
 
     gf = g.reshape(-1).astype(jnp.float32)
     rf = residual.reshape(-1).astype(jnp.float32)
-    g2, n = _pad_to_block(gf)
-    r2, _ = _pad_to_block(rf)
-    rows = g2.shape[0]
-    thr = jnp.full((1,), threshold, jnp.float32)
+    g2, n, rows = _pad_to_block(gf)
+    r2, _, _ = _pad_to_block(rf)
+    rows_padded = g2.shape[0]
+    br = _block_rows(rows)
     packed, newr = pl.pallas_call(
-        _kernel,
-        out_shape=(jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
-                   jax.ShapeDtypeStruct((rows, _BLOCK_COLS), jnp.float32)),
+        functools.partial(_kernel, float(threshold)),
+        grid=(rows_padded // br,),
+        in_specs=[pl.BlockSpec((br, _BLOCK_COLS), lambda i: (i, 0)),
+                  pl.BlockSpec((br, _BLOCK_COLS), lambda i: (i, 0))],
+        out_specs=(pl.BlockSpec((br, _LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((br, _BLOCK_COLS), lambda i: (i, 0))),
+        out_shape=(jax.ShapeDtypeStruct((rows_padded, _LANES), jnp.int32),
+                   jax.ShapeDtypeStruct((rows_padded, _BLOCK_COLS),
+                                        jnp.float32)),
         interpret=interpret,
-    )(g2, r2, thr)
-    return packed.reshape(-1), newr.reshape(-1)[:n]
+    )(g2, r2)
+    return packed[:rows].reshape(-1), newr.reshape(-1)[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("n", "threshold", "interpret"))
@@ -94,10 +113,19 @@ def dequantize_2bit(packed: jax.Array, n: int, threshold: float,
     from jax.experimental import pallas as pl
 
     rows = packed.shape[0] // _LANES
-    thr = jnp.full((1,), threshold, jnp.float32)
+    br = _block_rows(rows)
+    rows_padded = -(-rows // br) * br
+    p2 = packed.reshape(rows, _LANES)
+    if rows_padded != rows:
+        p2 = jnp.concatenate(
+            [p2, jnp.zeros((rows_padded - rows, _LANES), p2.dtype)])
     out = pl.pallas_call(
-        _dequant_kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, _BLOCK_COLS), jnp.float32),
+        functools.partial(_dequant_kernel, float(threshold)),
+        grid=(rows_padded // br,),
+        in_specs=[pl.BlockSpec((br, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, _BLOCK_COLS), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_padded, _BLOCK_COLS),
+                                       jnp.float32),
         interpret=interpret,
-    )(packed.reshape(rows, _LANES), thr)
+    )(p2)
     return out.reshape(-1)[:n]

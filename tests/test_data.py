@@ -88,3 +88,37 @@ def test_loader_augmentation_preserves_shapes_and_labels():
     np.testing.assert_array_equal(np.asarray(ya), np.asarray(yp))
     assert not np.array_equal(xa, xp)          # something moved
     np.testing.assert_array_equal(xa, xa2)     # seeded determinism
+
+
+def test_device_cache_loader_matches_host_path():
+    """device_cache=True gathers batches on device: identical values to
+    the host path without augmentation; with augmentation, shapes/labels
+    hold and the crop/flip kernel is seed-deterministic."""
+    import numpy as np
+
+    from geomx_tpu.data.loader import GeoDataLoader
+    from geomx_tpu.topology import HiPSTopology
+
+    topo = HiPSTopology(2, 2)
+    rng = np.random.RandomState(5)
+    x = (rng.rand(128, 16, 16, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, 128).astype(np.int32)
+
+    host = GeoDataLoader(x, y, topo, batch_size=8, seed=11)
+    dev = GeoDataLoader(x, y, topo, batch_size=8, seed=11,
+                        device_cache=True)
+    for (xh, yh), (xd, yd) in zip(host.epoch(1), dev.epoch(1)):
+        np.testing.assert_array_equal(np.asarray(xh), np.asarray(xd))
+        np.testing.assert_array_equal(np.asarray(yh), np.asarray(yd))
+
+    aug = GeoDataLoader(x, y, topo, batch_size=8, seed=11, augment=True,
+                        device_cache=True)
+    aug2 = GeoDataLoader(x, y, topo, batch_size=8, seed=11, augment=True,
+                         device_cache=True)
+    (xh, yh), (xa, ya), (xa2, _) = (next(iter(l.epoch(0)))
+                                    for l in (host, aug, aug2))
+    xa, xa2 = np.asarray(xa), np.asarray(xa2)
+    assert xa.shape == np.asarray(xh).shape and xa.dtype == np.uint8
+    np.testing.assert_array_equal(np.asarray(ya), np.asarray(yh))
+    assert not np.array_equal(xa, np.asarray(xh))
+    np.testing.assert_array_equal(xa, xa2)
