@@ -7,7 +7,9 @@ processes become blocking servers inside ``import mxnet``,
 python/mxnet/kvstore_server.py:30-89).  This demo reproduces that shape
 with geomx_tpu's GeoPSServer/GeoPSClient:
 
-  GEOMX_ROLE=global_server   — the global PS tier (one process)
+  GEOMX_ROLE=global_server   — a global PS tier process (MultiGPS: run
+                               GEOMX_NUM_GLOBAL_SERVERS of these, ids via
+                               GEOMX_GS_ID, ports GLOBAL_PORT+id)
   GEOMX_ROLE=server          — a party's local PS; relays to the global tier
   GEOMX_ROLE=worker          — trains, push/pull against its party's server
 
@@ -43,6 +45,10 @@ PARTY_ID = env("GEOMX_PARTY_ID", 0, int)
 WORKER_ID = env("GEOMX_WORKER_ID", 0, int)
 GLOBAL_PORT = env("GEOMX_PS_GLOBAL_PORT", 19700, int)
 LOCAL_PORT = env("GEOMX_PS_PORT", 19800, int)  # + party_id
+# MultiGPS on the host plane (reference kvstore_dist_server.h:1786-1826):
+# N global-server processes at GLOBAL_PORT..GLOBAL_PORT+N-1
+NUM_GLOBAL_SERVERS = env("GEOMX_NUM_GLOBAL_SERVERS", 1, int)
+GS_ID = env("GEOMX_GS_ID", 0, int)
 # multi-host: where the tiers live (reference DMLC_PS_GLOBAL_ROOT_URI /
 # DMLC_PS_ROOT_URI; localhost for the pseudo-distributed mode)
 GLOBAL_HOST = (env("GEOMX_PS_GLOBAL_HOST")
@@ -64,20 +70,22 @@ def run_global_server():
     from geomx_tpu.service import GeoPSServer
     # HFA: the global store accumulates parties' milestone deltas onto the
     # initial params, so it always holds the authoritative model
-    srv = GeoPSServer(port=GLOBAL_PORT, num_workers=NUM_PARTIES,
-                      mode=MODE, rank=0,
+    port = GLOBAL_PORT + GS_ID
+    srv = GeoPSServer(port=port, num_workers=NUM_PARTIES,
+                      mode=MODE, rank=GS_ID,
                       accumulate=(SYNC == "hfa")).start()
-    print(f"[global_server] listening on {GLOBAL_PORT} "
+    print(f"[global_server {GS_ID}] listening on {port} "
           f"({NUM_PARTIES} parties, {MODE})", flush=True)
     srv.join()
-    print("[global_server] stopped", flush=True)
+    print(f"[global_server {GS_ID}] stopped", flush=True)
 
 
 def run_local_server():
     from geomx_tpu.service import GeoPSServer
     port = LOCAL_PORT + PARTY_ID
     srv = GeoPSServer(port=port, num_workers=WORKERS_PER_PARTY, mode=MODE,
-                      global_addr=(GLOBAL_HOST, GLOBAL_PORT),
+                      global_addrs=[(GLOBAL_HOST, GLOBAL_PORT + i)
+                                    for i in range(NUM_GLOBAL_SERVERS)],
                       compression=COMPRESSION, rank=1 + PARTY_ID,
                       global_sender_id=1000 + PARTY_ID,
                       hfa_k2=HFA_K2 if SYNC == "hfa" else None,
@@ -178,8 +186,12 @@ def run_worker():
         acc = float((np.argmax(logits, 1) == y).mean())
         t_logits = xt @ params["w"] + params["b"]
         t_acc = float((np.argmax(t_logits, 1) == yt).mean())
+        # NOTE: under HFA, non-milestone rounds pull the party-local
+        # average, so per-party accuracies may disagree until the next K2
+        # milestone sync (reference semantics, ADVICE r2 #4)
+        scope = " (party-local)" if SYNC == "hfa" else ""
         print(f"[worker p{PARTY_ID}w{WORKER_ID}] epoch {ep} "
-              f"train_acc {acc:.3f} test_acc {t_acc:.3f}", flush=True)
+              f"train_acc {acc:.3f} test_acc {t_acc:.3f}{scope}", flush=True)
 
     if SYNC == "hfa" and global_step % HFA_K1 != 0:
         # flush the drift accumulated since the last K1 boundary so every

@@ -91,8 +91,11 @@ class MultiGPSPlan:
 
     def mixed_example(self, tree: Any) -> Any:
         """Host-side mixed view for state inits: big leaves -> a zero
-        [shard_len] float32 leaf (optimizer/compressor state is allocated
-        per shard — the 1/W memory saving), small leaves unchanged."""
+        [shard_len] leaf in float32 — the sharded update runs a float32
+        master copy regardless of param dtype (scatter_grad_leaf also
+        accumulates in f32), so the optimizer state matches the shard the
+        update math actually sees; bf16/f16 params re-cast on the
+        all_gather back (unshard_param_leaf).  Small leaves unchanged."""
         def f(leaf):
             leaf = jnp.asarray(leaf)
             if self.is_big(leaf.size):
@@ -113,10 +116,14 @@ class MultiGPSPlan:
                                 scatter_dimension=0) / self.W
 
     def shard_param_leaf(self, p: jax.Array, widx: jax.Array) -> jax.Array:
-        """This slot's contiguous parameter shard (zero-padded tail)."""
+        """This slot's contiguous parameter shard (zero-padded tail), as
+        the float32 master copy the sharded optimizer runs on (matching
+        mixed_example's f32 state and scatter_grad_leaf's f32 reduce);
+        unshard_param_leaf casts back to the param dtype."""
         n = p.size
         s = self.shard_len(n)
-        pf = jnp.zeros((s * self.W,), p.dtype).at[:n].set(p.reshape(-1))
+        pf = jnp.zeros((s * self.W,), jnp.float32).at[:n].set(
+            p.reshape(-1).astype(jnp.float32))
         return lax.dynamic_slice(pf, (widx * s,), (s,))
 
     def unshard_param_leaf(self, new_shard: jax.Array, like: jax.Array,

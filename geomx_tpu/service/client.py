@@ -8,6 +8,12 @@ The process analogue of the reference's KVWorker
 - sends drain through a priority queue (native C++ when built), so
   ``priority=-layer_idx`` pushes leave the host in layer order: the P3
   send discipline (threadsafe_queue.h:19-60);
+- with P3 enabled (GEOMX_ENABLE_P3/ENABLE_P3, or ``p3_slice_elems``),
+  big pushes are sliced into priority-tagged CHUNK messages before they
+  enter the send queue, so chunks of a front layer overtake the queued
+  tail of a back layer on the wire — the reference's P3_ZPush per-chunk
+  scheduling (kvstore_dist.h:835-872; chunk size = bigarray_bound/2);
+  the server reassembles;
 - a receiver thread matches replies to requests by request id, like the
   Customer recv thread tracking (timestamp -> response) pairs
   (src/customer.cc:13-87).
@@ -41,7 +47,8 @@ class _Pending:
 class GeoPSClient:
     def __init__(self, addr: Tuple[str, int], sender_id: int = 0,
                  resend_timeout_ms: Optional[int] = None,
-                 auto_pull: bool = False):
+                 auto_pull: bool = False,
+                 p3_slice_elems: Optional[int] = None):
         """``auto_pull=True`` registers this client for server-initiated
         updates (the TSEngine AutoPull path): after each aggregation round
         the server pushes fresh values in throughput-scheduled order, and
@@ -60,6 +67,20 @@ class GeoPSClient:
             resend_timeout_ms = env_int(
                 ("GEOMX_RESEND_TIMEOUT", "PS_RESEND_TIMEOUT"), 1000)
         self.resend_timeout_ms = resend_timeout_ms
+        # P3 chunking: default on when the reference's env toggle is set,
+        # slicing at bigarray_bound/2 elements like P3_EncodeDefaultKey
+        if p3_slice_elems is None and env_int(
+                ("GEOMX_ENABLE_P3", "ENABLE_P3"), 0):
+            p3_slice_elems = env_int(
+                ("GEOMX_P3_SLICE_ELEMS",),
+                env_int(("GEOMX_BIGARRAY_BOUND",
+                         "MXNET_KVSTORE_BIGARRAY_BOUND"), 1_000_000) // 2)
+        self.p3_slice_elems = p3_slice_elems
+        self._slicer = None
+        if p3_slice_elems:
+            from geomx_tpu.transport import P3Slicer
+            self._slicer = P3Slicer(p3_slice_elems)
+        self._multi: Dict[int, list] = {}   # meta-rid -> per-chunk rids
         self._sock = connect_retry(addr)
         self._wlock = threading.Lock()
         # random rid base so a restarted worker reusing a sender_id cannot
@@ -71,6 +92,11 @@ class GeoPSClient:
 
         self._sendq = self._make_queue()
         self._native_q = type(self._sendq).__name__ == "NativePriorityQueue"
+        # test/demo hook: while cleared, the sender holds the wire so
+        # queued messages re-order by priority (P3 interleaving is
+        # observable deterministically)
+        self._send_gate = threading.Event()
+        self._send_gate.set()
         self._sender = threading.Thread(target=self._send_loop, daemon=True)
         self._sender.start()
         self._receiver = threading.Thread(target=self._recv_loop, daemon=True)
@@ -97,6 +123,7 @@ class GeoPSClient:
             item = self._sendq.pop()
             if item is None:
                 return
+            self._send_gate.wait()
             frame = item[0] if self._native_q else item
             with self._wlock:
                 try:
@@ -170,10 +197,41 @@ class GeoPSClient:
         self._sendq.push(frame, priority)
         return rid
 
+    def pause_sending(self) -> None:
+        """Hold the wire: queued messages accumulate in the priority queue
+        (so their eventual send order is by priority, not submission)."""
+        self._send_gate.clear()
+
+    def resume_sending(self) -> None:
+        self._send_gate.set()
+
     def wait(self, rid: int, timeout: Optional[float] = None) -> Msg:
         """Block until request `rid` completes (reference Customer::Wait).
         With resend enabled, the request is retransmitted each time the
-        resend timeout expires without a reply."""
+        resend timeout expires without a reply.  A chunked P3 push's
+        meta-rid waits on every chunk."""
+        subs = self._multi.pop(rid, None)
+        if subs is not None:
+            import time as _time
+            deadline = None if timeout is None else \
+                _time.monotonic() + timeout
+            reply = None
+            for i, r in enumerate(subs):
+                remain = None if deadline is None else \
+                    max(1e-3, deadline - _time.monotonic())
+                try:
+                    reply = self._wait_one(r, remain)
+                except BaseException:
+                    # the push as a whole failed: drop the sibling chunks'
+                    # pending entries (each retains its frame for resend)
+                    with self._plock:
+                        for r2 in subs[i + 1:]:
+                            self._pending.pop(r2, None)
+                    raise
+            return reply
+        return self._wait_one(rid, timeout)
+
+    def _wait_one(self, rid: int, timeout: Optional[float] = None) -> Msg:
         with self._plock:
             p = self._pending.get(rid)
         if p is None:
@@ -212,25 +270,53 @@ class GeoPSClient:
 
     # ---- KVWorker surface --------------------------------------------------
 
-    def init(self, key: str, value: np.ndarray) -> None:
-        self._request(Msg(MsgType.INIT, key=key,
+    def init(self, key: str, value: np.ndarray,
+             meta: Optional[dict] = None) -> None:
+        self._request(Msg(MsgType.INIT, key=key, meta=dict(meta or {}),
                           array=np.asarray(value, np.float32)))
 
-    def push(self, key: str, grad: np.ndarray, priority: int = 0) -> None:
-        self.wait(self.push_async(key, grad, priority))
+    def push(self, key: str, grad: np.ndarray, priority: int = 0,
+             meta: Optional[dict] = None) -> None:
+        self.wait(self.push_async(key, grad, priority, meta=meta))
 
-    def push_async(self, key: str, grad: np.ndarray, priority: int = 0) -> int:
-        return self._submit(Msg(MsgType.PUSH, key=key,
-                                array=np.asarray(grad, np.float32)),
+    def push_async(self, key: str, grad: np.ndarray, priority: int = 0,
+                   meta: Optional[dict] = None) -> int:
+        g = np.asarray(grad)
+        if g.dtype != np.float16:  # fp16 wire payloads keep their dtype
+            g = g.astype(np.float32, copy=False)
+        if self._slicer is not None and g.size > self.p3_slice_elems \
+                and not meta:
+            # P3: slice into priority-tagged chunks; each is an independent
+            # resendable PUSH, reassembled server-side.  One key must not
+            # have two chunked pushes from the same sender in flight (the
+            # training loop pushes each key once per round, as the
+            # reference's does).
+            flat = g.reshape(-1)
+            rids = [self._submit(
+                Msg(MsgType.PUSH, key=key,
+                    meta={"chunk": ch.index, "num_chunks": ch.num_chunks,
+                          "start": ch.start, "n_total": int(g.size),
+                          "shape": list(g.shape)},
+                    array=flat[ch.start:ch.stop]),
+                priority=priority)
+                for ch in self._slicer.chunks(key, int(g.size), priority)]
+            mrid = next(self._rid)
+            self._multi[mrid] = rids
+            return mrid
+        return self._submit(Msg(MsgType.PUSH, key=key, meta=dict(meta or {}),
+                                array=g),
                             priority=priority)
 
     def pull(self, key: str, priority: int = 0,
-             timeout: Optional[float] = 60.0) -> np.ndarray:
-        reply = self.wait(self.pull_async(key, priority), timeout)
+             timeout: Optional[float] = 60.0,
+             meta: Optional[dict] = None) -> np.ndarray:
+        reply = self.wait(self.pull_async(key, priority, meta=meta), timeout)
         return np.asarray(reply.array, np.float32)
 
-    def pull_async(self, key: str, priority: int = 0) -> int:
-        return self._submit(Msg(MsgType.PULL, key=key), priority=priority)
+    def pull_async(self, key: str, priority: int = 0,
+                   meta: Optional[dict] = None) -> int:
+        return self._submit(Msg(MsgType.PULL, key=key, meta=dict(meta or {})),
+                            priority=priority)
 
     def auto_pull(self, key: str, min_version: int = 0,
                   timeout: Optional[float] = 60.0) -> np.ndarray:
@@ -309,6 +395,7 @@ class GeoPSClient:
         if self._closed:
             return
         self._closed = True
+        self._send_gate.set()  # release a paused sender so it can exit
         self._sendq.close()
         try:
             self._sock.close()

@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomx_tpu.service.protocol import (Msg, MsgType, connect_retry,
+from geomx_tpu.service.protocol import (Msg, MsgType, env_int,
                                         recv_frame, send_frame, should_drop)
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
 
@@ -47,6 +47,9 @@ class _KeyState:
         # HFA: last globally-agreed value (the reference's stored_milestone,
         # kvstore_dist_server.h:988-1017)
         self.milestone: Optional[np.ndarray] = None
+        # a WAN relay for this key failed: its round can never complete,
+        # so pulls that would wait on it must fail fast with the reason
+        self.relay_error: Optional[str] = None
 
 
 class GeoPSServer:
@@ -56,6 +59,7 @@ class GeoPSServer:
     def __init__(self, port: int = 0, num_workers: int = 1,
                  mode: str = "sync", optimizer=None,
                  global_addr: Optional[tuple] = None,
+                 global_addrs: Optional[list] = None,
                  compression: Optional[str] = None,
                  heartbeat_timeout: float = 15.0,
                  accumulate: bool = False,
@@ -65,7 +69,8 @@ class GeoPSServer:
                  auto_pull: Optional[bool] = None,
                  max_greed_rate: Optional[float] = None,
                  hfa_k2: Optional[int] = None,
-                 num_global_workers: int = 1):
+                 num_global_workers: int = 1,
+                 bigarray_bound: Optional[int] = None):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -95,6 +100,16 @@ class GeoPSServer:
         self._barrier_waiters = []
         self._stops = 0
         self._seen_pushes: Dict[Any, bool] = {}
+        # MultiGPS placement per key: key -> (owner, bounds); bounds is a
+        # cumulative split across all global servers for big tensors,
+        # None for hash-placed whole tensors
+        self._gplace: Dict[str, tuple] = {}
+        # P3 reassembly buffers: (sender, key) -> partial state for an
+        # in-flight chunked push (server side of kvstore_dist.h:835-872)
+        self._p3_partial: Dict[Any, dict] = {}
+        # arrival order of (sender, key, chunk) — TCP preserves the
+        # client's send order, so tests/demos can assert P3 interleaving
+        self.push_log: list = []
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
         self.rank = rank
         self._conn_wlocks: Dict[int, threading.Lock] = {}
@@ -122,13 +137,26 @@ class GeoPSServer:
         self._ap_ids: Dict[int, int] = {}     # sender id -> scheduler index
         self._ap_queue: "queue.Queue" = queue.Queue()
         self._ap_thread: Optional[threading.Thread] = None
+        # WAN relay jobs (key, payload, is_hfa_milestone) — see _relay_loop
+        self._relay_q: "queue.Queue" = queue.Queue()
+        self._relay_thread: Optional[threading.Thread] = None
         # remotely-controllable profiler (reference kSetProfilerParams,
         # kvstore_dist_server.h:383-430)
         from geomx_tpu.utils.profiler import Profiler
         self.profiler = Profiler(rank=rank)
 
-        self._global_addr = global_addr
-        self._global_sock: Optional[socket.socket] = None
+        # MultiGPS: N global servers with reference placement (hash small
+        # tensors whole, split big ones across all servers —
+        # kvstore_dist.h:792-833, kvstore_dist_server.h:1786-1826)
+        if global_addrs is None:
+            global_addrs = [global_addr] if global_addr is not None else []
+        self._global_addrs = list(global_addrs)
+        self._gclients: list = []
+        if bigarray_bound is None:
+            bigarray_bound = env_int(("GEOMX_BIGARRAY_BOUND",
+                                      "MXNET_KVSTORE_BIGARRAY_BOUND"),
+                                     1_000_000)
+        self.bigarray_bound = int(bigarray_bound)
         # this server's identity at the global tier (the reference's second
         # node identity my_node_global_, van.h:100); must be unique per party
         if global_sender_id is None:
@@ -161,8 +189,14 @@ class GeoPSServer:
     # ---- lifecycle ---------------------------------------------------------
 
     def start(self):
-        if self._global_addr is not None:
-            self._global_sock = connect_retry(self._global_addr)
+        if self._global_addrs:
+            from geomx_tpu.service.client import GeoPSClient
+            self._gclients = [
+                GeoPSClient(addr, sender_id=self._global_sender_id)
+                for addr in self._global_addrs]
+            self._relay_thread = threading.Thread(target=self._relay_loop,
+                                                  daemon=True)
+            self._relay_thread.start()
         self._accept_thread.start()
         if self.ts_sched is not None:
             self._ap_thread = threading.Thread(target=self._autopull_loop,
@@ -172,6 +206,7 @@ class GeoPSServer:
 
     def stop(self):
         self._running = False
+        self._relay_q.put(None)
         try:
             self._srv.close()
         except OSError:
@@ -189,10 +224,10 @@ class GeoPSServer:
                 c.close()
             except OSError:
                 pass
-        if self._global_sock is not None:
+        for c in self._gclients:
             try:
-                send_frame(self._global_sock, Msg(MsgType.STOP))
-                self._global_sock.close()
+                c.stop_server()
+                c.close()
             except OSError:
                 pass
 
@@ -282,13 +317,12 @@ class GeoPSServer:
                     # propagate upward so the global tier owns every key
                     # (the reference inits global store on first push-
                     # through, kvstore_dist_server.h:1241-1273)
-                    if self._global_sock is not None:
-                        fwd = Msg(MsgType.INIT, key=msg.key,
-                                  meta={"reliable": True}, array=msg.array)
-                        fwd.sender = self._global_sender_id
-                        send_frame(self._global_sock, fwd)
-                        rep = recv_frame(self._global_sock)
-                        if rep is None or rep.type == MsgType.ERROR:
+                    if self._gclients:
+                        try:
+                            self._global_init(msg.key,
+                                              np.asarray(msg.array,
+                                                         np.float32))
+                        except Exception as e:
                             # undo the local registration so a retried
                             # INIT re-forwards; surface the failure
                             del self._store[msg.key]
@@ -296,7 +330,8 @@ class GeoPSServer:
                             if self._compressor is not None:
                                 self._comp_state.pop(msg.key, None)
                             raise RuntimeError(
-                                f"global INIT failed for {msg.key}: {rep}")
+                                f"global INIT failed for {msg.key}: "
+                                f"{e!r}")
             self._reply(conn, msg, Msg(MsgType.ACK, key=msg.key))
         elif t == MsgType.PUSH:
             self._handle_push(conn, msg)
@@ -335,22 +370,20 @@ class GeoPSServer:
             # A local-tier server forwards it up: the optimizer runs on the
             # GLOBAL tier (kvstore_dist_server.h:512-515 — python updater
             # executes on global servers; local tier is pure aggregation).
-            if self._global_sock is not None:
-                with self._lock:
-                    fwd = Msg(MsgType.COMMAND,
-                              meta=dict(msg.meta, reliable=True))
-                    fwd.sender = self._global_sender_id
-                    send_frame(self._global_sock, fwd)
-                    reply = recv_frame(self._global_sock)
-                # a global-tier failure must reach the worker, not be
-                # swallowed into a blind ACK (it would train with the
-                # overwrite store and silently diverge)
-                if reply is None:
+            if self._gclients:
+                # every global server gets the optimizer (MultiGPS: each
+                # runs it on its own key range).  A global-tier failure
+                # must reach the worker, not be swallowed into a blind ACK
+                # (it would train with the overwrite store and silently
+                # diverge)
+                try:
+                    with self._lock:
+                        for c in self._gclients:
+                            c._request(Msg(MsgType.COMMAND,
+                                           meta=dict(msg.meta)))
+                except Exception as e:
                     self._reply(conn, msg, Msg(MsgType.ERROR, meta={
-                        "error": "global tier died during set_optimizer"}))
-                    return
-                if reply.type == MsgType.ERROR:
-                    self._reply(conn, msg, reply)
+                        "error": f"global set_optimizer failed: {e!r}"}))
                     return
             else:
                 config = (msg.meta["name"], msg.meta.get("kwargs", {}))
@@ -459,6 +492,38 @@ class GeoPSServer:
         else:
             st.value = grad.astype(st.value.dtype)
 
+    def _placement(self, key: str, size: int) -> tuple:
+        """Reference MultiGPS placement for the host plane: tensors >=
+        bigarray_bound split contiguously across all global servers,
+        smaller ones hashed whole (kvstore_dist.h:792-833; string keys
+        hash via crc32 in place of the reference's int keys).  Keys under
+        a dc-tier compressor are never split: their relay payloads are
+        compressed whole (value+index pairs are indivisible), so they
+        route to the hash owner."""
+        import zlib
+
+        from geomx_tpu.parallel.multigps import HASH_PRIME
+        S = len(self._gclients)
+        owner = (zlib.crc32(key.encode("utf-8")) * HASH_PRIME) % max(S, 1)
+        if S > 1 and self._compressor is None and \
+                size >= self.bigarray_bound:
+            per = size // S
+            bounds = tuple(i * per for i in range(S)) + (size,)
+            return -1, bounds
+        return owner, None
+
+    def _global_init(self, key: str, value: np.ndarray) -> None:
+        """Place a key on the global tier (whole or sharded)."""
+        owner, bounds = self._placement(key, value.size)
+        self._gplace[key] = (owner, bounds)
+        if bounds is None:
+            self._gclients[owner].init(key, value, meta={"reliable": True})
+            return
+        flat = value.reshape(-1)
+        for i, c in enumerate(self._gclients):
+            c.init(key, flat[bounds[i]:bounds[i + 1]],
+                   meta={"reliable": True})
+
     def _relay_to_global(self, key: str, grad: np.ndarray) -> np.ndarray:
         """Push the party aggregate up, pull fresh globals back
         (DataPushToGlobalServers* + DataPullFromGlobalServers*)."""
@@ -466,6 +531,24 @@ class GeoPSServer:
             return self._relay_to_global_impl(key, grad)
 
     def _relay_to_global_impl(self, key: str, grad: np.ndarray) -> np.ndarray:
+        owner, bounds = self._gplace.get(
+            key, (0, None) if len(self._gclients) == 1
+            else self._placement(key, grad.size))
+        if bounds is not None:
+            # MultiGPS split relay: shard i goes to global server i (all
+            # hops async, merged back on pull — the reference's multi-
+            # server slicer + reassembly, kvstore_dist_server.h:1025-1082)
+            flat = np.asarray(grad, np.float32).reshape(-1)
+            ts = [c.push_async(key, flat[bounds[i]:bounds[i + 1]],
+                               meta={"reliable": True})
+                  for i, c in enumerate(self._gclients)]
+            for c, t in zip(self._gclients, ts):
+                c.wait(t)
+            rids = [c.pull_async(key, meta={"reliable": True})
+                    for c in self._gclients]
+            parts = [np.asarray(c.wait(r).array, np.float32)
+                     for c, r in zip(self._gclients, rids)]
+            return np.concatenate(parts).reshape(grad.shape)
         meta = {}
         payload = grad
         if self._compressor is not None and \
@@ -486,23 +569,13 @@ class GeoPSServer:
                         "shape": list(grad.shape)}
         elif self._compressor is not None and self._compressor.name == "fp16":
             payload = grad.astype(np.float16)
-        # the relay hop blocks under the store lock with no resender, so it
-        # opts out of drop injection (meta["reliable"])
+        # the relay hop blocks under the store lock, so it opts out of
+        # drop injection (meta["reliable"])
         meta["reliable"] = True
-        push = Msg(MsgType.PUSH, key=key, meta=meta, array=payload)
-        push.sender = self._global_sender_id
-        send_frame(self._global_sock, push)
-        reply = recv_frame(self._global_sock)
-        if reply is None or reply.type == MsgType.ERROR:
-            raise RuntimeError(f"global relay failed: {reply}")
-        pull = Msg(MsgType.PULL, key=key, meta={"reliable": True})
-        pull.sender = self._global_sender_id
-        send_frame(self._global_sock, pull)
-        pulled = recv_frame(self._global_sock)
-        if pulled is None or pulled.type == MsgType.ERROR or \
-                pulled.array is None:
-            raise RuntimeError(f"global relay pull failed: {pulled}")
-        return np.asarray(pulled.array, np.float32)
+        c = self._gclients[owner]
+        c.push(key, payload, meta=meta)
+        pulled = c.pull(key, meta={"reliable": True})
+        return np.asarray(pulled, np.float32).reshape(grad.shape)
 
     def _decompress_incoming(self, msg: Msg) -> np.ndarray:
         if msg.meta.get("comp") == "bsc":
@@ -533,6 +606,9 @@ class GeoPSServer:
                 and msg.sender >= 0:
             sig = (msg.sender, msg.meta["rid"])
         with self._lock:
+            self.push_log.append((msg.sender, key, msg.meta.get("chunk")))
+            if len(self.push_log) > 65536:
+                del self.push_log[:32768]
             if sig is not None:
                 if sig in self._seen_pushes:
                     self._reply(conn, msg, Msg(MsgType.ACK, key=key))
@@ -543,19 +619,53 @@ class GeoPSServer:
                 self._seen_pushes[sig] = True
                 while len(self._seen_pushes) > 65536:
                     self._seen_pushes.pop(next(iter(self._seen_pushes)))
+            if msg.meta.get("chunk") is not None:
+                full = self._p3_accumulate(msg, grad)
+                if full is None:   # more chunks outstanding
+                    self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+                    return
+                grad = full        # final chunk: merge the whole tensor;
+                # its ACK comes from _push_locked below
             try:
                 self._push_locked(conn, msg, key, grad)
             except Exception:
                 if sig is not None:
                     self._seen_pushes.pop(sig, None)
                 raise
+            if msg.meta.get("chunk") is not None:
+                # only clear the buffer once the merge really happened, so
+                # a retransmitted final chunk can retry after a failure
+                self._p3_partial.pop((msg.sender, key), None)
+
+    def _p3_accumulate(self, msg: Msg, piece: np.ndarray):
+        """Collect one P3 chunk; returns the reassembled tensor when the
+        set completes, else None.  Caller holds self._lock.  Keyed by
+        (sender, key): one chunked push per key per sender may be in
+        flight, which the per-round push discipline guarantees."""
+        pk = (msg.sender, msg.key)
+        part = self._p3_partial.get(pk)
+        n_total = int(msg.meta["n_total"])
+        num = int(msg.meta["num_chunks"])
+        if part is None or part["n_total"] != n_total \
+                or part["num"] != num:
+            part = {"buf": np.zeros((n_total,), np.float32), "got": set(),
+                    "num": num, "n_total": n_total,
+                    "shape": tuple(msg.meta["shape"])}
+            self._p3_partial[pk] = part
+        start = int(msg.meta["start"])
+        flat = np.asarray(piece, np.float32).reshape(-1)
+        part["buf"][start:start + flat.size] = flat
+        part["got"].add(int(msg.meta["chunk"]))
+        if len(part["got"]) < part["num"]:
+            return None
+        return part["buf"].reshape(part["shape"])
 
     def _push_locked(self, conn, msg: Msg, key: str, grad: np.ndarray):
         """The merge/apply body; caller holds self._lock."""
         st = self._store[key]
         if self.mode == "async":
             # arrival-ordered apply (DataHandleAsyncDefault)
-            if self._global_sock is not None:
+            if self._gclients:
                 fresh = self._relay_to_global(key, grad)
                 st.value = fresh
             else:
@@ -575,7 +685,7 @@ class GeoPSServer:
         self._reply(conn, msg, Msg(MsgType.ACK, key=key))
         if st.count >= self.num_workers:
             merged, st.merged, st.count = st.merged, None, 0
-            if self._global_sock is not None:
+            if self._gclients:
                 if self.hfa_k2 is not None:
                     # HFA: `merged` is the party-average parameters (workers
                     # push params/num_workers).  Apply it every round so
@@ -590,33 +700,87 @@ class GeoPSServer:
                         # model (init + every synced delta), so the pull
                         # returns authoritative params — parties whose
                         # milestones ever disagreed reconverge here,
-                        # unlike rebasing on the local milestone
+                        # unlike rebasing on the local milestone.
+                        # The WAN hop itself runs on the relay thread so
+                        # a straggler party's global barrier cannot stall
+                        # this server's other keys/pulls/heartbeats
+                        # (ADVICE r2 #3); the round completes on install.
                         delta = (st.value.astype(np.float32) - st.milestone) \
                             / self.num_global_workers
-                        st.value = self._relay_to_global(key, delta)
-                        st.milestone = st.value.copy()
+                        self._relay_q.put((key, delta, True))
+                        return
                 else:
-                    st.value = self._relay_to_global(key, merged)
+                    self._relay_q.put((key, merged, False))
+                    return
             else:
                 self._apply(key, merged)
-            st.round += 1
-            still = []
-            for c, rid, need in st.waiting_pulls:
-                if st.round >= need:
-                    reply = Msg(MsgType.PULL_REPLY, key=key,
-                                array=st.value)
+            self._finish_round_locked(key, st)
+
+    def _finish_round_locked(self, key: str, st: _KeyState):
+        """Complete a sync round: bump the round counter, answer the pulls
+        it unblocks, feed the TS distributor.  Caller holds self._lock."""
+        st.round += 1
+        still = []
+        for c, rid, need in st.waiting_pulls:
+            if st.round >= need:
+                reply = Msg(MsgType.PULL_REPLY, key=key,
+                            array=st.value)
+                if rid is not None:
+                    reply.meta["rid"] = rid
+                self._send_msg(c, reply)
+            else:
+                still.append((c, rid, need))
+        st.waiting_pulls = still
+        if self.ts_sched is not None:
+            # hand an immutable snapshot to the distributor thread:
+            # blocking sends must not run under self._lock (a stalled
+            # client would freeze the whole tier), and NativeSGD
+            # mutates st.value in place on later rounds
+            self._ap_queue.put((key, st.value.copy(), st.round))
+
+    def _relay_loop(self):
+        """Dedicated WAN-relay thread: the blocking push-through to the
+        global tier runs here, never under self._lock, so one straggling
+        party cannot freeze this server's pulls/pushes/heartbeats.  Jobs
+        are FIFO, preserving per-key round order."""
+        while True:
+            item = self._relay_q.get()
+            if item is None:
+                return
+            key, payload, is_milestone = item
+            try:
+                fresh = self._relay_to_global(key, payload)
+            except Exception as e:
+                # the round can never complete: fail current waiters fast
+                # with the reason, latch the error so pulls that arrive
+                # AFTER the failure (the common case — the network round
+                # trip races the exception) also fail instead of parking
+                # forever, and log it server-side
+                import sys
+                print(f"[geomx-ps rank {self.rank}] global relay failed "
+                      f"for {key!r}: {e!r}", file=sys.stderr, flush=True)
+                with self._lock:
+                    st = self._store.get(key)
+                    if st is None:
+                        continue
+                    st.relay_error = f"global relay failed: {e!r}"
+                    waiters, st.waiting_pulls = st.waiting_pulls, []
+                for c, rid, _need in waiters:
+                    err = Msg(MsgType.ERROR,
+                              meta={"error": st.relay_error})
                     if rid is not None:
-                        reply.meta["rid"] = rid
-                    self._send_msg(c, reply)
-                else:
-                    still.append((c, rid, need))
-            st.waiting_pulls = still
-            if self.ts_sched is not None:
-                # hand an immutable snapshot to the distributor thread:
-                # blocking sends must not run under self._lock (a stalled
-                # client would freeze the whole tier), and NativeSGD
-                # mutates st.value in place on later rounds
-                self._ap_queue.put((key, st.value.copy(), st.round))
+                        err.meta["rid"] = rid
+                    try:
+                        self._send_msg(c, err)
+                    except OSError:
+                        pass
+                continue
+            with self._lock:
+                st = self._store[key]
+                st.value = fresh
+                if is_milestone:
+                    st.milestone = fresh.copy()
+                self._finish_round_locked(key, st)
 
     def _autopull_loop(self):
         while self._running or not self._ap_queue.empty():
@@ -670,6 +834,11 @@ class GeoPSServer:
             # per-round request bookkeeping, kvstore_dist_server.h:1138-1168)
             need = st.pushed.get(msg.sender, 0)
             if self.mode == "sync" and st.round < need:
+                if st.relay_error is not None:
+                    # this round is lost (WAN relay failed) — fail fast
+                    self._reply(conn, msg, Msg(
+                        MsgType.ERROR, meta={"error": st.relay_error}))
+                    return
                 rid = msg.meta.get("rid")
                 # a resent PULL (same connection, same rid) must not queue
                 # twice — the original entry will answer it; different

@@ -110,5 +110,14 @@ class DGTCompressor(Compressor):
         return summed, new_state
 
     def wire_bytes_leaf(self, leaf: jax.Array) -> int:
+        """Amortized bytes per sync.  Non-drain steps move ~k of the
+        blocks, but every ``flush_every``-th step is a drain that sends
+        everything pending, so the honest steady-state average is
+
+            (flush_every - 1) * k + 1   of   flush_every   full payloads
+
+        (k for the top blocks each step, the full tensor on the drain)."""
         inner_bytes = self.inner.wire_bytes_leaf(leaf)
-        return int(inner_bytes * min(1.0, self.k))
+        f = self.flush_every
+        frac = (min(1.0, self.k) * (f - 1) + 1.0) / f
+        return int(inner_bytes * frac)

@@ -83,6 +83,18 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                 "sharded update lives in gradient space; param-space "
                 f"algorithms ({sync.name}) do not compose with it")
         mgps = MultiGPSPlan(config.bigarray_bound, topology.workers_per_party)
+        from geomx_tpu.compression.base import NoCompressor
+        if not isinstance(sync.worker_compressor, NoCompressor):
+            import warnings
+            # big leaves' worker-tier reduce is the psum_scatter itself
+            # (already a 1/W wire saving per link); a configured worker
+            # compressor applies only to the small replicated leaves, and
+            # the user should know the big ones bypass it (ADVICE r2 #1)
+            warnings.warn(
+                "multi_gps: leaves >= bigarray_bound use the sharded "
+                "psum_scatter reduce and BYPASS the worker-tier "
+                f"compressor ({sync.worker_compressor.name}); it still "
+                "applies to smaller leaves", stacklevel=2)
 
     def _mgps_sync_update(grads, params, opt_state, sync_state, step):
         """MultiGPS: hierarchical reduce + optimizer with big leaves

@@ -8,7 +8,10 @@ model state, *and* sync-algorithm state (milestones, compressor
 residuals) — round-trips, which is strictly stronger: resuming an HFA/BSC
 run reproduces the exact error-feedback trajectory.
 
-Uses orbax-checkpoint when available, with a plain pickle fallback.
+Format: a single pickle of host numpy trees (atomic tmp-file + rename).
+Self-contained by design — no checkpoint-library dependency — and
+portable across hosts; swap in an orbax CheckpointManager at the call
+sites if multi-host async checkpointing is ever needed.
 """
 
 from __future__ import annotations
@@ -31,9 +34,12 @@ def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
         path = os.path.join(path, f"step_{step}")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     host_state = _to_host(state)
-    with open(path if path.endswith(".ckpt") else path + ".ckpt", "wb") as f:
+    final = path if path.endswith(".ckpt") else path + ".ckpt"
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(host_state, f)
-    return path if path.endswith(".ckpt") else path + ".ckpt"
+    os.replace(tmp, final)  # a crash mid-write never corrupts a checkpoint
+    return final
 
 
 def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:

@@ -26,8 +26,12 @@ pids=()
 cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
 trap cleanup EXIT
 
-GEOMX_ROLE=global_server python examples/dist_ps.py &
-pids+=($!)
+: "${GEOMX_NUM_GLOBAL_SERVERS:=1}"
+export GEOMX_NUM_GLOBAL_SERVERS
+for ((g = 0; g < GEOMX_NUM_GLOBAL_SERVERS; g++)); do
+  GEOMX_ROLE=global_server GEOMX_GS_ID=$g python examples/dist_ps.py &
+  pids+=($!)
+done
 sleep 1
 
 for ((p = 0; p < GEOMX_NUM_PARTIES; p++)); do

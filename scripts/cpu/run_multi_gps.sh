@@ -7,6 +7,13 @@
 set -euo pipefail
 source "$(dirname "$0")/../common.sh"
 
-export GEOMX_MULTI_GPS=1
+# host plane: N global-server processes, big tensors key-range-sharded
+# across them (the reference's process topology)
+export GEOMX_NUM_GLOBAL_SERVERS="${GEOMX_NUM_GLOBAL_SERVERS:-2}"
 export GEOMX_BIGARRAY_BOUND="${GEOMX_BIGARRAY_BOUND:-1000}"
+"$(dirname "$0")/run_dist_ps.sh" "$@"
+
+# SPMD plane: the same capability as a ZeRO-1 sharded update over the
+# worker mesh axis (geomx_tpu/parallel/multigps.py)
+export GEOMX_MULTI_GPS=1
 run_on_cpu_mesh examples/cnn.py -d synthetic -ep 2 "$@"

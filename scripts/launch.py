@@ -87,6 +87,10 @@ def main():
                     default=int(os.environ.get("GEOMX_WORKERS_PER_PARTY", 2)))
     ap.add_argument("--hostfile", default=None,
                     help="one host per line; omit for all-local")
+    ap.add_argument("--num-global-servers", type=int,
+                    default=int(os.environ.get("GEOMX_NUM_GLOBAL_SERVERS", 1)),
+                    help="MultiGPS: N global PS processes at "
+                         "global-port..global-port+N-1")
     ap.add_argument("--global-port", type=int,
                     default=int(os.environ.get("GEOMX_PS_GLOBAL_PORT", 19700)))
     ap.add_argument("--local-port", type=int,
@@ -123,6 +127,7 @@ def main():
         "GEOMX_PS_GLOBAL_PORT": str(args.global_port),
         "GEOMX_PS_PORT": str(args.local_port),
         "GEOMX_PS_GLOBAL_HOST": global_host or "127.0.0.1",
+        "GEOMX_NUM_GLOBAL_SERVERS": str(args.num_global_servers),
         # tag every process so remote cleanup can pkill by launch id
         "GEOMX_LAUNCH_ID": launch_id,
     })
@@ -132,8 +137,10 @@ def main():
 
     procs, workers = [], []
     try:
-        env = dict(base, GEOMX_ROLE="global_server")
-        procs.append(spawn(cmd, env, global_host, "global_server", launch_id))
+        for g in range(args.num_global_servers):
+            env = dict(base, GEOMX_ROLE="global_server", GEOMX_GS_ID=str(g))
+            procs.append(spawn(cmd, env, global_host, f"global_server:{g}",
+                               launch_id))
         time.sleep(args.server_start_delay)
 
         for p in range(args.num_parties):

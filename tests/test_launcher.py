@@ -33,4 +33,4 @@ def test_local_launch_end_to_end():
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     # every worker reported accuracy and the servers stopped cleanly
     assert proc.stdout.count("test_acc") >= 2, proc.stdout
-    assert "[global_server] stopped" in proc.stdout, proc.stdout
+    assert "[global_server 0] stopped" in proc.stdout, proc.stdout
