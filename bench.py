@@ -245,12 +245,19 @@ def _time_to_accuracy(batch):
                                  augment=not synthetic, device_cache=True)
     state = trainer.init_state(jax.random.PRNGKey(0),
                                data["train_x"][:2])
-    run = trainer._epoch_runner(loader)
+    scan = jax.devices()[0].platform == "tpu"
     t0 = time.perf_counter()
     best = 0.0
     for ep in range(max_epochs):
-        sel, key = loader.epoch_indices(ep)
-        state, _ = run(state, loader._dev_x, loader._dev_y, sel, key)
+        if scan:
+            sel, key = loader.epoch_indices(ep)
+            run = trainer._epoch_runner(loader)
+            state, _ = run(state, loader._dev_x, loader._dev_y, sel, key)
+        else:
+            for i, (xb, yb) in enumerate(loader.epoch(ep)):
+                state, metrics = trainer.train_step(state, xb, yb)
+                if i % 32 == 0:
+                    jax.block_until_ready(metrics["loss"])
         acc = trainer.evaluate(state, data["test_x"], data["test_y"])
         best = max(best, acc)
         if acc >= target:
@@ -286,10 +293,14 @@ def _fit_overhead(batch, iters, bare_sps):
     y = rng.randint(0, 10, size=(n,)).astype(np.int32)
     loader = trainer.make_loader(x, y, batch, device_cache=True)
     state = trainer.init_state(jax.random.PRNGKey(0), x[:2])
+    # scanned epochs pay off on the chip (one dispatch/epoch); on the CPU
+    # debug platform the scan recompiles under donation churn, so use the
+    # per-step path there
+    scan = jax.devices()[0].platform == "tpu"
     # two warm epochs: compile, then the donated-layout fixed point
-    state, _ = trainer.fit(state, loader, epochs=2, scan_epochs=True)
+    state, _ = trainer.fit(state, loader, epochs=2, scan_epochs=scan)
     t0 = time.perf_counter()
-    state, _ = trainer.fit(state, loader, epochs=1, scan_epochs=True)
+    state, _ = trainer.fit(state, loader, epochs=1, scan_epochs=scan)
     jax.block_until_ready(state.step)
     dt = time.perf_counter() - t0
     sps = loader.steps_per_epoch * batch / dt

@@ -121,8 +121,15 @@ def run_worker():
 
     port = LOCAL_PORT + PARTY_ID
     resend = env("PS_RESEND", 0, int)
+    # intra-party TSEngine (ENABLE_INTRA_TS): push side joins the ASK1
+    # relay overlay (ts_push), pull side consumes server-initiated
+    # AutoPull updates — the reference's full TS data path
+    intra_ts = bool(env("GEOMX_ENABLE_INTRA_TS", 0, int)
+                    or env("ENABLE_INTRA_TS", 0, int))
     c = GeoPSClient((LOCAL_HOST, port), sender_id=WORKER_ID,
-                    resend_timeout_ms=1000 if resend else None)
+                    resend_timeout_ms=1000 if resend else None,
+                    auto_pull=intra_ts,
+                    ts_node=WORKER_ID + 1 if intra_ts else None)
 
     d, classes = 64, 10
     x, y, xt, yt = make_data()
@@ -176,6 +183,15 @@ def run_worker():
                                priority=-pr)
                     for k in sorted(params):
                         params[k] = c.pull(k)
+                continue
+            if intra_ts:
+                # announce partials to the ASK1 scheduler; the aggregate
+                # reaches the server through the relay tree, and the fresh
+                # value comes back via AutoPull dissemination
+                for k in sorted(params):
+                    c.ts_push(k, np.asarray(g[k]))
+                for k in sorted(params):
+                    params[k] = c.auto_pull(k, min_version=global_step)
                 continue
             # P3 discipline: front-layer keys get higher priority
             for pr, k in enumerate(sorted(params)):

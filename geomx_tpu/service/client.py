@@ -22,10 +22,13 @@ The process analogue of the reference's KVWorker
 from __future__ import annotations
 
 import itertools
+import os
 import pickle
+import queue
 import random
 import socket
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -48,11 +51,18 @@ class GeoPSClient:
     def __init__(self, addr: Tuple[str, int], sender_id: int = 0,
                  resend_timeout_ms: Optional[int] = None,
                  auto_pull: bool = False,
-                 p3_slice_elems: Optional[int] = None):
+                 p3_slice_elems: Optional[int] = None,
+                 ts_node: Optional[int] = None):
         """``auto_pull=True`` registers this client for server-initiated
         updates (the TSEngine AutoPull path): after each aggregation round
         the server pushes fresh values in throughput-scheduled order, and
-        ``auto_pull(key)`` consumes them instead of issuing a PULL."""
+        ``auto_pull(key)`` consumes them instead of issuing a PULL.
+
+        ``ts_node`` (1-based; 0 is the server sink) additionally joins the
+        TSEngine push-side overlay: ``ts_push`` announces a ready partial
+        via ASK1 and a relay listener accepts peers' partials, which are
+        merged and re-announced — the scheduler-chosen aggregation tree of
+        the reference (kv_app.h:313-341, kvstore_dist.h:91-169)."""
         self.sender_id = sender_id
         self._autopull: Dict[str, Any] = {}
         self._apevents: Dict[str, threading.Event] = {}
@@ -81,6 +91,9 @@ class GeoPSClient:
             from geomx_tpu.transport import P3Slicer
             self._slicer = P3Slicer(p3_slice_elems)
         self._multi: Dict[int, list] = {}   # meta-rid -> per-chunk rids
+        # per-key push round ids: lets the server dedup a restarted
+        # worker's replayed push exactly (see recover())
+        self._key_rounds: Dict[str, int] = {}
         self._sock = connect_retry(addr)
         self._wlock = threading.Lock()
         # random rid base so a restarted worker reusing a sender_id cannot
@@ -101,6 +114,29 @@ class GeoPSClient:
         self._sender.start()
         self._receiver = threading.Thread(target=self._recv_loop, daemon=True)
         self._receiver.start()
+        self.ts_node = ts_node
+        self._ts_buf: Dict[str, list] = {}   # key -> [array, num_merge]
+        self._ts_lock = threading.Lock()
+        self._ts_peers: Dict[Tuple[str, int], socket.socket] = {}
+        self._ts_directives: "queue.Queue" = queue.Queue()
+        if ts_node is not None:
+            self._ts_listener = socket.socket(socket.AF_INET,
+                                              socket.SOCK_STREAM)
+            self._ts_listener.setsockopt(socket.SOL_SOCKET,
+                                         socket.SO_REUSEADDR, 1)
+            bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
+            self._ts_listener.bind((bind_host, 0))
+            self._ts_listener.listen(16)
+            self._ts_listener.settimeout(0.2)
+            self.relay_port = self._ts_listener.getsockname()[1]
+            threading.Thread(target=self._relay_accept_loop,
+                             daemon=True).start()
+            threading.Thread(target=self._ts_dispatch_loop,
+                             daemon=True).start()
+            adv = os.environ.get("GEOMX_RELAY_HOST", "127.0.0.1")
+            self._request(Msg(MsgType.COMMAND,
+                              meta={"cmd": "ts_register", "node": ts_node,
+                                    "host": adv, "port": self.relay_port}))
         if auto_pull:
             self._request(Msg(MsgType.COMMAND,
                               meta={"cmd": "register_autopull"}))
@@ -157,6 +193,11 @@ class GeoPSClient:
                     for ev in self._apevents.values():
                         ev.set()
                 return
+            if msg.type == MsgType.TS_DIRECTIVE:
+                # scheduler decided where this node's partial goes; the
+                # dispatcher thread moves the data (never the recv loop)
+                self._ts_directives.put(msg)
+                continue
             if msg.type == MsgType.AUTOPULL:
                 # unsolicited server-initiated update (TSEngine AutoPull):
                 # no rid — park it for auto_pull() waiters
@@ -284,6 +325,8 @@ class GeoPSClient:
         g = np.asarray(grad)
         if g.dtype != np.float16:  # fp16 wire payloads keep their dtype
             g = g.astype(np.float32, copy=False)
+        rnd = self._key_rounds.get(key, 0) + 1
+        self._key_rounds[key] = rnd
         if self._slicer is not None and g.size > self.p3_slice_elems \
                 and not meta:
             # P3: slice into priority-tagged chunks; each is an independent
@@ -296,15 +339,16 @@ class GeoPSClient:
                 Msg(MsgType.PUSH, key=key,
                     meta={"chunk": ch.index, "num_chunks": ch.num_chunks,
                           "start": ch.start, "n_total": int(g.size),
-                          "shape": list(g.shape)},
+                          "shape": list(g.shape), "round": rnd},
                     array=flat[ch.start:ch.stop]),
                 priority=priority)
                 for ch in self._slicer.chunks(key, int(g.size), priority)]
             mrid = next(self._rid)
             self._multi[mrid] = rids
             return mrid
-        return self._submit(Msg(MsgType.PUSH, key=key, meta=dict(meta or {}),
-                                array=g),
+        m = dict(meta or {})
+        m.setdefault("round", rnd)
+        return self._submit(Msg(MsgType.PUSH, key=key, meta=m, array=g),
                             priority=priority)
 
     def pull(self, key: str, priority: int = 0,
@@ -339,6 +383,138 @@ class GeoPSClient:
             if remain is not None and remain <= 0:
                 raise TimeoutError(f"auto_pull({key!r}) timed out")
             ev.wait(remain if remain is None else min(remain, 1.0))
+
+    def recover(self) -> Dict[str, int]:
+        """Reconnect-and-resume for a restarted worker: fetch how many
+        rounds this sender id already contributed per key and resume the
+        client-side round counters from there, so a replayed in-flight
+        push dedups server-side instead of double-merging (the recovery
+        state re-send of the reference's scheduler, van.cc:165-212)."""
+        reply = self._request(Msg(MsgType.COMMAND,
+                                  meta={"cmd": "query_progress"}))
+        prog = {str(k): int(v)
+                for k, v in dict(reply.meta.get("progress", {})).items()}
+        self._key_rounds.update(prog)
+        return prog
+
+    # ---- TSEngine push-side overlay (ASK1 aggregation tree) ---------------
+
+    def ts_push(self, key: str, grad: np.ndarray, num_merge: int = 1) -> None:
+        """Merge a partial aggregate into the local buffer and announce it
+        to the scheduler (reference TS_ZPush, kv_app.h:313-341: stash via
+        the request handle, then Ask1).  The data moves later, when a
+        TS_DIRECTIVE pairs this node — to a peer (relay merge) or to the
+        server (sink) with the accumulated num_merge count.  Completion is
+        observed via auto_pull / a min_round-gated pull, not a per-push
+        ACK."""
+        if self.ts_node is None:
+            raise RuntimeError("client not in TS mode (pass ts_node=)")
+        g = np.asarray(grad, np.float32)
+        with self._ts_lock:
+            buf = self._ts_buf.get(key)
+            if buf is None:
+                self._ts_buf[key] = [g.copy(), int(num_merge)]
+            else:
+                buf[0] = buf[0] + g
+                buf[1] += int(num_merge)
+        self._request(Msg(MsgType.COMMAND,
+                          meta={"cmd": "ts_ask1", "node": self.ts_node,
+                                "key": key}))
+
+    def _relay_accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._ts_listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            threading.Thread(target=self._relay_serve, args=(conn,),
+                             daemon=True).start()
+
+    def _relay_serve(self, conn: socket.socket):
+        """Accept peers' partials: merge-and-forward (the WorkersMerge
+        role, kvstore_dist.h:91-169) — merge into the local buffer, ACK,
+        re-announce via ASK1."""
+        while not self._closed:
+            try:
+                msg = recv_frame(conn)
+            except (OSError, pickle.UnpicklingError, ValueError):
+                return
+            if msg is None:
+                return
+            if msg.type != MsgType.RELAY:
+                continue
+            self.ts_push(msg.key, msg.array,
+                         num_merge=int(msg.meta.get("num_merge", 1)))
+            try:
+                send_frame(conn, Msg(MsgType.ACK, key=msg.key))
+            except OSError:
+                return
+
+    def _ts_dispatch_loop(self):
+        while not self._closed:
+            try:
+                d = self._ts_directives.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            key = d.key
+            with self._ts_lock:
+                buf = self._ts_buf.pop(key, None)
+            if buf is None:
+                continue  # ghost directive: nothing buffered
+            arr, m = buf
+            to = int(d.meta.get("to", 0))
+            if to == 0:
+                self.push(key, arr, meta={"num_merge": m})
+                continue
+            addr = (d.meta["host"], int(d.meta["port"]))
+            t0 = time.monotonic()
+            try:
+                self._relay_send(addr, key, arr, m)
+            except OSError:
+                # unreachable peer: sink our own partial directly AND tell
+                # the scheduler, which directs the stranded receiver (whose
+                # ask was consumed by this pairing) straight to the sink —
+                # otherwise its buffered partial never moves and the round
+                # cannot complete
+                self.push(key, arr, meta={"num_merge": m})
+                try:
+                    self._request(Msg(MsgType.COMMAND, meta={
+                        "cmd": "ts_relay_failed", "key": key,
+                        "receiver": int(d.meta["to"])}))
+                except Exception:
+                    pass
+                continue
+            dt = max(time.monotonic() - t0, 1e-9)
+            try:  # throughput feedback steers future pairings
+                self._request(Msg(MsgType.COMMAND, meta={
+                    "cmd": "ts_report", "sender": self.ts_node,
+                    "receiver": to, "throughput": arr.nbytes / dt}))
+            except Exception:
+                pass
+
+    def _relay_send(self, addr, key: str, arr: np.ndarray, m: int):
+        sock = self._ts_peers.get(addr)
+        if sock is None:
+            sock = connect_retry(addr, total_timeout_s=10.0)
+            self._ts_peers[addr] = sock
+        msg = Msg(MsgType.RELAY, key=key, meta={"num_merge": m}, array=arr)
+        msg.sender = self.sender_id
+        try:
+            send_frame(sock, msg)
+            rep = recv_frame(sock)
+        except OSError:
+            self._ts_peers.pop(addr, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        if rep is None or rep.type != MsgType.ACK:
+            self._ts_peers.pop(addr, None)
+            raise OSError(f"relay to {addr} rejected: {rep}")
 
     def barrier(self, timeout: Optional[float] = 120.0) -> None:
         """Tier-wide barrier (reference kvstore.py:_barrier): returns once
@@ -401,6 +577,16 @@ class GeoPSClient:
             self._sock.close()
         except OSError:
             pass
+        if self.ts_node is not None:
+            try:
+                self._ts_listener.close()
+            except OSError:
+                pass
+            for s in self._ts_peers.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
         # free the native queue only after the sender can no longer touch it
         self._sender.join(timeout=2.0)
         if self._native_q and not self._sender.is_alive():

@@ -44,6 +44,10 @@ class MsgType(enum.IntEnum):
     ERROR = 11
     AUTOPULL = 12        # server-initiated update (TSEngine AutoPull,
                          # reference kv_app.h:364 / AUTOPULLREPLY)
+    TS_DIRECTIVE = 13    # scheduler -> node: send your partial to X
+                         # (reference ASK1 reply, van.cc:1238-1296)
+    RELAY = 14           # node -> node partial-aggregate transfer
+                         # (reference TS_Process merge path, kv_app.h:1520)
 
 
 class _HeaderUnpickler(pickle.Unpickler):

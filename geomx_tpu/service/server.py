@@ -70,7 +70,9 @@ class GeoPSServer:
                  max_greed_rate: Optional[float] = None,
                  hfa_k2: Optional[int] = None,
                  num_global_workers: int = 1,
-                 bigarray_bound: Optional[int] = None):
+                 bigarray_bound: Optional[int] = None,
+                 inter_ts: Optional[bool] = None,
+                 global_ts_node: Optional[int] = None):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -133,6 +135,18 @@ class GeoPSServer:
             self.ts_sched = TSEngineScheduler(num_workers,
                                               max_greed_rate=max_greed_rate,
                                               seed=rank)
+        # TSEngine push-side (ASK1) scheduler: pairs nodes holding ready
+        # partials into a relay-merge tree with this server as sink 0
+        # (van.cc:1238-1296).  On whenever intra- or inter-TS is enabled —
+        # the worker tier and the global tier run the same machinery.
+        self.ts_push_sched = None
+        if auto_pull or env_int(("GEOMX_ENABLE_INTRA_TS",
+                                 "ENABLE_INTRA_TS"), 0) \
+                or env_int(("GEOMX_ENABLE_INTER_TS", "ENABLE_INTER_TS"), 0):
+            from geomx_tpu.transport.tsengine import TSEngineScheduler
+            self.ts_push_sched = TSEngineScheduler(num_workers + 1,
+                                                   seed=100 + rank)
+        self._ts_nodes: Dict[int, dict] = {}   # ts node id -> conn/addr
         self._ap_conns: Dict[int, Any] = {}   # scheduler index -> conn
         self._ap_ids: Dict[int, int] = {}     # sender id -> scheduler index
         self._ap_queue: "queue.Queue" = queue.Queue()
@@ -164,6 +178,19 @@ class GeoPSServer:
                 global_sender_id = GeoPSServer._next_gid
                 GeoPSServer._next_gid += 1
         self._global_sender_id = global_sender_id
+        # inter-party TSEngine (ENABLE_INTER_TS): this server joins the
+        # global tier's ASK1 relay overlay as node `global_ts_node`
+        # (default: its rank, which dist_ps assigns as 1+party_id), so
+        # party aggregates relay-merge across parties before the sink.
+        # Requires a single uncompressed global link (relay merges are
+        # additive sums).
+        if inter_ts is None:
+            inter_ts = bool(env_int(("GEOMX_ENABLE_INTER_TS",
+                                     "ENABLE_INTER_TS"), 0))
+        self.inter_ts = inter_ts and compression is None
+        self._global_ts_node = global_ts_node if global_ts_node is not None \
+            else max(1, rank)
+        self._ground: Dict[str, int] = {}   # key -> global rounds joined
         self._compressor = None
         if compression:
             from geomx_tpu.compression import get_compressor
@@ -191,9 +218,16 @@ class GeoPSServer:
     def start(self):
         if self._global_addrs:
             from geomx_tpu.service.client import GeoPSClient
+            ts = self.inter_ts and len(self._global_addrs) == 1
             self._gclients = [
-                GeoPSClient(addr, sender_id=self._global_sender_id)
+                GeoPSClient(addr, sender_id=self._global_sender_id,
+                            ts_node=self._global_ts_node if ts else None)
                 for addr in self._global_addrs]
+            for c in self._gclients:
+                # a RESTARTED local server must resume its global push
+                # round ids where its dead incarnation left off, or the
+                # round-dedup would absorb all its future relays
+                c.recover()
             self._relay_thread = threading.Thread(target=self._relay_loop,
                                                   daemon=True)
             self._relay_thread.start()
@@ -204,7 +238,10 @@ class GeoPSServer:
             self._ap_thread.start()
         return self
 
-    def stop(self):
+    def stop(self, forward: bool = True):
+        """``forward=False`` detaches from the global tier WITHOUT
+        sending kStopServer up — the rolling-restart/crash case, where a
+        replacement server will re-register under the same identity."""
         self._running = False
         self._relay_q.put(None)
         try:
@@ -226,7 +263,8 @@ class GeoPSServer:
                 pass
         for c in self._gclients:
             try:
-                c.stop_server()
+                if forward:
+                    c.stop_server()
                 c.close()
             except OSError:
                 pass
@@ -420,6 +458,56 @@ class GeoPSServer:
                         return
                     self._ap_ids[msg.sender] = idx
                 self._ap_conns[idx] = conn
+        elif cmd == "ts_register":
+            # a TS node announces its relay listener; directives for it go
+            # down this connection
+            with self._lock:
+                if self.ts_push_sched is None:
+                    self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                        "error": "server not in TS mode"}))
+                    return
+                self._ts_nodes[int(msg.meta["node"])] = {
+                    "conn": conn,
+                    "addr": (msg.meta["host"], int(msg.meta["port"]))}
+        elif cmd == "ts_ask1":
+            if self.ts_push_sched is None:
+                self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                    "error": "server not in TS mode"}))
+                return
+            # pairing rounds count only REGISTERED overlay nodes: peers
+            # that opted out of TS (e.g. a compressed party at the global
+            # tier) push directly and must not be waited for.  TS clients
+            # register at construction, before any training push; the
+            # demos barrier after init so registration races can't shrink
+            # a round's pool mid-flight.
+            with self._lock:
+                num_pushers = max(1, len(self._ts_nodes))
+            directive = self.ts_push_sched.ask1_key(
+                int(msg.meta["node"]), msg.meta["key"], num_pushers)
+            self._reply(conn, msg, Msg(MsgType.ACK))
+            if directive is not None:
+                self._send_ts_directive(msg.meta["key"], *directive)
+            return
+        elif cmd == "ts_relay_failed":
+            # a sender could not reach its designated receiver and sank
+            # its own partial directly.  Abort the key's pairing round
+            # conservatively: the stranded receiver AND every still-queued
+            # node go straight to the sink, and the round state resets —
+            # the aggregate still lands exactly once per contribution.
+            k = msg.meta["key"]
+            to_sink = {int(msg.meta["receiver"])}
+            if self.ts_push_sched is not None:
+                to_sink.update(self.ts_push_sched.drain_key(k))
+            for node in to_sink:
+                self._send_ts_directive(k, node, 0)
+            self._reply(conn, msg, Msg(MsgType.ACK))
+            return
+        elif cmd == "ts_report":
+            if self.ts_push_sched is not None:
+                self.ts_push_sched.report(
+                    int(msg.meta["sender"]), int(msg.meta["receiver"]),
+                    float(msg.meta["throughput"]),
+                    self.ts_push_sched.iters)
         elif cmd == "set_profiler_params":
             self.profiler.set_config(**msg.meta.get("params", {}))
         elif cmd == "profiler_start":
@@ -429,6 +517,16 @@ class GeoPSServer:
         elif cmd == "profiler_dump":
             path = self.profiler.dump()
             self._reply(conn, msg, Msg(MsgType.ACK, meta={"path": path}))
+            return
+        elif cmd == "query_progress":
+            # recovery state for a (re)joining worker: its per-key merged
+            # round counts, so it resumes its round ids where the dead
+            # incarnation left off
+            with self._lock:
+                prog = {k: st.pushed.get(msg.sender, 0)
+                        for k, st in self._store.items()}
+            self._reply(conn, msg, Msg(MsgType.ACK,
+                                       meta={"progress": prog}))
             return
         elif cmd == "num_dead_nodes":
             self._reply(conn, msg, Msg(
@@ -441,6 +539,26 @@ class GeoPSServer:
                                        meta={"error": f"bad cmd {cmd}"}))
             return
         self._reply(conn, msg, Msg(MsgType.ACK))
+
+    def _send_ts_directive(self, key: str, sender: int, receiver: int):
+        """Tell `sender` where its partial goes (the ASK1 reply).  An
+        unregistered receiver degrades to the sink so the round always
+        completes."""
+        with self._lock:
+            info = self._ts_nodes.get(sender)
+            rinfo = self._ts_nodes.get(receiver) if receiver != 0 else None
+        if info is None:
+            return  # sender vanished; its heartbeat death will surface
+        d = Msg(MsgType.TS_DIRECTIVE, key=key, meta={"to": receiver})
+        if receiver != 0:
+            if rinfo is None:
+                d.meta["to"] = 0
+            else:
+                d.meta["host"], d.meta["port"] = rinfo["addr"]
+        try:
+            self._send_msg(info["conn"], d)
+        except OSError:
+            pass
 
     # ---- the data path -----------------------------------------------------
 
@@ -531,9 +649,11 @@ class GeoPSServer:
             return self._relay_to_global_impl(key, grad)
 
     def _relay_to_global_impl(self, key: str, grad: np.ndarray) -> np.ndarray:
-        owner, bounds = self._gplace.get(
-            key, (0, None) if len(self._gclients) == 1
-            else self._placement(key, grad.size))
+        place = self._gplace.get(key)
+        if place is None:
+            place = (0, None) if len(self._gclients) == 1 \
+                else self._placement(key, grad.size)
+        owner, bounds = place
         if bounds is not None:
             # MultiGPS split relay: shard i goes to global server i (all
             # hops async, merged back on pull — the reference's multi-
@@ -542,13 +662,25 @@ class GeoPSServer:
             ts = [c.push_async(key, flat[bounds[i]:bounds[i + 1]],
                                meta={"reliable": True})
                   for i, c in enumerate(self._gclients)]
+            # bounded waits: a hung global server must raise and hit the
+            # relay thread's fail-fast path, not wedge the FIFO forever
             for c, t in zip(self._gclients, ts):
-                c.wait(t)
+                c.wait(t, timeout=120.0)
             rids = [c.pull_async(key, meta={"reliable": True})
                     for c in self._gclients]
-            parts = [np.asarray(c.wait(r).array, np.float32)
+            parts = [np.asarray(c.wait(r, timeout=120.0).array, np.float32)
                      for c, r in zip(self._gclients, rids)]
             return np.concatenate(parts).reshape(grad.shape)
+        c0 = self._gclients[owner]
+        if c0.ts_node is not None:
+            # inter-party TS: announce the partial to the global ASK1
+            # scheduler (it may relay-merge through a faster party before
+            # hitting the sink) and gate the pull on the round we joined
+            rnd = self._ground[key] = self._ground.get(key, 0) + 1
+            c0.ts_push(key, np.asarray(grad, np.float32))
+            pulled = c0.pull(key, timeout=120.0,
+                             meta={"min_round": rnd, "reliable": True})
+            return np.asarray(pulled, np.float32).reshape(grad.shape)
         meta = {}
         payload = grad
         if self._compressor is not None and \
@@ -679,8 +811,23 @@ class GeoPSServer:
                 # distributor thread serializes outside self._lock
                 self._ap_queue.put((key, st.value.copy(), st.round))
             return
+        # worker-rejoin safety: a restarted worker that died before its
+        # push was ACKed replays it.  Pushes that carry a client round id
+        # (meta["round"], maintained by GeoPSClient and restored by
+        # recover()) are absorbed with an idempotent ACK when that round
+        # was already merged from this sender — the recovery discipline
+        # the reference gets from is_recovery + skipped barriers
+        # (van.cc:165-212, kvstore_dist.h:63-67).
+        r = msg.meta.get("round")
+        if r is not None and msg.sender >= 0 and \
+                int(r) <= st.pushed.get(msg.sender, 0):
+            self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+            return
         st.merged = grad if st.merged is None else st.merged + grad
-        st.count += 1
+        # a TS relay-merged push carries the contributions of num_merge
+        # workers (reference KVMeta.num_merge counting toward the sync
+        # gate, kvstore_dist_server.h:1324)
+        st.count += int(msg.meta.get("num_merge", 1))
         st.pushed[msg.sender] = st.pushed.get(msg.sender, 0) + 1
         self._reply(conn, msg, Msg(MsgType.ACK, key=key))
         if st.count >= self.num_workers:
@@ -727,7 +874,11 @@ class GeoPSServer:
                             array=st.value)
                 if rid is not None:
                     reply.meta["rid"] = rid
-                self._send_msg(c, reply)
+                try:
+                    self._send_msg(c, reply)
+                except OSError:
+                    pass  # dead waiter (crashed worker): drop its entry —
+                    # the round must still complete for the live ones
             else:
                 still.append((c, rid, need))
         st.waiting_pulls = still
@@ -832,7 +983,11 @@ class GeoPSServer:
             # value; pulls never wait on rounds they did not join (that
             # deadlocks cross-worker pipelining — the reference gates on
             # per-round request bookkeeping, kvstore_dist_server.h:1138-1168)
-            need = st.pushed.get(msg.sender, 0)
+            # a puller that relayed its contribution through a TS peer
+            # never pushed directly; meta["min_round"] gates its pull on
+            # the aggregation round it joined
+            need = max(st.pushed.get(msg.sender, 0),
+                       int(msg.meta.get("min_round", 0)))
             if self.mode == "sync" and st.round < need:
                 if st.relay_error is not None:
                     # this round is lost (WAN relay failed) — fail fast

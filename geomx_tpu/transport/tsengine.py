@@ -59,6 +59,8 @@ class TSEngineScheduler:
         # push pairing queue (ASK1)
         self._ask_q: deque = deque()
         self._push_done: List[bool] = [False] * num_nodes
+        # per-key ASK1 round state (ask1_key)
+        self._push_keys: Dict = {}
 
     # ---- dissemination (pull) ---------------------------------------------
 
@@ -97,6 +99,55 @@ class TSEngineScheduler:
             return receiver
 
     # ---- aggregation pairing (push) ---------------------------------------
+
+    def ask1_key(self, node: int, key,
+                 num_pushers: int) -> Optional[Tuple[int, int]]:
+        """Per-key Ask1 pairing round (ProcessAsk1Command, van.cc:1238-1296,
+        redesigned with per-key state instead of the reference's global
+        FIFO so concurrent keys cannot cross-pair).
+
+        ``node`` (1-based; 0 is the sink/server) announces it holds a
+        partial aggregate of ``key``.  Returns a directive (sender,
+        receiver) when a pairing is decided, else None (wait).  Each
+        pairing removes one holder; after num_pushers-1 pairings the last
+        holder is directed to the sink (0) and the round resets.  Repeat
+        asks while a node is already queued are ignored (reference's
+        ask_q dedup), so one directive disposes a node's whole merged
+        buffer."""
+        with self._lock:
+            st = self._push_keys.setdefault(
+                key, {"q": deque(), "pairs": 0})
+            if node in st["q"]:
+                return None
+            if st["pairs"] >= num_pushers - 1:
+                # the final merged holder: everything reduces to the sink
+                st["pairs"] = 0
+                st["q"].clear()
+                return (node, 0)
+            st["q"].append(node)
+            if len(st["q"]) < 2:
+                return None
+            a = st["q"].popleft()
+            b = st["q"].popleft()
+            ab = self.A[a][b] if self.A[a][b] is not None else -1.0
+            ba = self.A[b][a] if self.A[b][a] is not None else -1.0
+            # the node with the better measured path to its partner sends
+            sender, receiver = (a, b) if ab > ba else (b, a)
+            st["pairs"] += 1
+            return (sender, receiver)
+
+    def drain_key(self, key) -> List[int]:
+        """Abort the key's pairing round (a relay failed): return every
+        still-queued node — the caller directs them straight to the sink
+        — and reset the round state so the next round starts clean."""
+        with self._lock:
+            st = self._push_keys.get(key)
+            if st is None:
+                return []
+            queued = list(st["q"])
+            st["q"].clear()
+            st["pairs"] = 0
+            return queued
 
     def ask1(self, node: int) -> Optional[Tuple[int, int]]:
         """Node reports its partial aggregate is ready; returns a directed

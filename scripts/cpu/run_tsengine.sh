@@ -11,4 +11,12 @@ source "$(dirname "$0")/../common.sh"
 export GEOMX_ENABLE_INTER_TS=1
 export GEOMX_ENABLE_INTRA_TS=1
 export GEOMX_MAX_GREED_RATE="${GEOMX_MAX_GREED_RATE:-0.9}"
+
+# host plane: intra-TS (worker ASK1 relay tree + AutoPull dissemination)
+# and inter-TS (party relay tree into the global tier) end-to-end on the
+# real multi-process topology
+"$(dirname "$0")/run_dist_ps.sh" "$@"
+
+# SPMD plane: XLA schedules the collectives; the scheduler brain drives
+# the host-side dissemination only
 run_on_cpu_mesh examples/cnn.py -d synthetic -ep 2 "$@"

@@ -1,0 +1,175 @@
+"""Failure recovery: worker death + rejoin on the PS plane.
+
+Parity target: the reference detects dead nodes via heartbeat
+(van.cc:1147-1160), marks re-registrations is_recovery and re-sends
+cluster state (van.cc:165-212), and skips barriers on recovery
+(kvstore_dist.h:63-67).  Here: a restarted worker reconnects under its
+sender id, replays INIT idempotently, resumes its push round ids from the
+server (recover()), and the job completes with the correct aggregate.
+"""
+
+import numpy as np
+
+from geomx_tpu.service import GeoPSClient, GeoPSServer
+
+
+def test_worker_restart_resumes_job():
+    """Kill worker 1 mid-run; a restarted incarnation re-registers,
+    recovers its progress, finishes the job; the aggregate is exact."""
+    server = GeoPSServer(num_workers=2, mode="sync", accumulate=True).start()
+    c0 = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    c1 = GeoPSClient(("127.0.0.1", server.port), sender_id=1)
+    n = 200
+    for c in (c0, c1):
+        c.init("w", np.zeros(n, np.float32))
+
+    # round 1 completes normally
+    c0.push("w", np.full(n, 1.0, np.float32))
+    c1.push("w", np.full(n, 2.0, np.float32))
+    assert np.allclose(c0.pull("w"), 3.0)
+
+    # worker 1 dies abruptly (socket torn down, no STOP)
+    c1._sock.close()
+
+    # ... and is restarted: same sender id, fresh client state
+    c1b = GeoPSClient(("127.0.0.1", server.port), sender_id=1)
+    c1b.init("w", np.zeros(n, np.float32))   # replayed INIT: idempotent
+    prog = c1b.recover()
+    assert prog["w"] == 1                    # resumes after round 1
+
+    # round 2 completes with the recovered worker
+    c0.push("w", np.full(n, 1.0, np.float32))
+    c1b.push("w", np.full(n, 2.0, np.float32))
+    assert np.allclose(c0.pull("w"), 6.0)
+    assert np.allclose(c1b.pull("w"), 6.0)
+
+    c0.stop_server()
+    c1b.stop_server()
+    for c in (c0, c1b):
+        c.close()
+
+
+def test_replayed_inflight_push_not_double_merged():
+    """A worker that died after its push was merged (but before the ACK
+    landed) replays the same round on restart: the server absorbs it."""
+    server = GeoPSServer(num_workers=2, mode="sync", accumulate=True).start()
+    c0 = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    c1 = GeoPSClient(("127.0.0.1", server.port), sender_id=1)
+    n = 50
+    for c in (c0, c1):
+        c.init("w", np.zeros(n, np.float32))
+
+    # worker 1 pushes round 1 (merged server-side), then dies
+    c1.push("w", np.full(n, 5.0, np.float32))
+    c1._sock.close()
+
+    # restart: recover() says round 1 already counted; the replay (same
+    # round id) must be an idempotent ACK, not a second merge
+    c1b = GeoPSClient(("127.0.0.1", server.port), sender_id=1)
+    assert c1b.recover()["w"] == 1
+    c1b._key_rounds["w"] = 0           # simulate pre-crash state: it
+    c1b.push("w", np.full(n, 5.0, np.float32))  # replays round 1
+    c0.push("w", np.full(n, 1.0, np.float32))
+    assert np.allclose(c0.pull("w"), 6.0)  # 5 + 1, not 11
+
+    c0.stop_server()
+    c1b.stop_server()
+    for c in (c0, c1b):
+        c.close()
+
+
+def test_round_completes_past_dead_waiting_pull():
+    """A crashed worker parked in waiting_pulls must not prevent the
+    round from completing for the live workers."""
+    import threading
+    import time
+
+    server = GeoPSServer(num_workers=2, mode="sync", accumulate=True).start()
+    c0 = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    c1 = GeoPSClient(("127.0.0.1", server.port), sender_id=1)
+    n = 20
+    for c in (c0, c1):
+        c.init("w", np.zeros(n, np.float32))
+
+    # worker 1 pushes and parks a pull, then dies before the round closes
+    c1.push("w", np.full(n, 2.0, np.float32))
+    c1.pull_async("w")
+    time.sleep(0.3)                    # let the pull reach waiting_pulls
+    c1._sock.close()
+
+    c0.push("w", np.full(n, 1.0, np.float32))
+    out = c0.pull("w", timeout=30.0)   # must not hang or error
+    assert np.allclose(out, 3.0)
+
+    c0.stop_server()
+    c0.close()
+
+
+def test_heartbeat_detects_dead_worker():
+    server = GeoPSServer(num_workers=2, mode="sync",
+                         heartbeat_timeout=0.3).start()
+    c0 = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    c1 = GeoPSClient(("127.0.0.1", server.port), sender_id=1)
+    c0.heartbeat()
+    c1.heartbeat()
+    assert c0.num_dead_nodes(timeout=0.3) == 0
+    c1._sock.close()
+    import time
+    time.sleep(0.5)
+    c0.heartbeat()
+    assert c0.num_dead_nodes(timeout=0.3) >= 1  # worker 1 went silent
+    c0.stop_server()
+    c0.close()
+
+
+def test_restarted_local_server_relays_are_not_swallowed():
+    """A restarted local server (same global_sender_id) must resume its
+    global round ids via recover(), or the global tier would absorb all
+    its future relays as replays (code-review r3 finding)."""
+    gsrv = GeoPSServer(num_workers=1, mode="sync", rank=0).start()
+    loc1 = GeoPSServer(num_workers=1, mode="sync",
+                       global_addr=("127.0.0.1", gsrv.port),
+                       global_sender_id=1000, rank=1).start()
+    c = GeoPSClient(("127.0.0.1", loc1.port), sender_id=0)
+    n = 40
+    c.init("w", np.zeros(n, np.float32))
+    c.push("w", np.full(n, 1.0, np.float32))
+    assert np.allclose(c.pull("w"), 1.0)
+    c.close()
+    loc1.stop(forward=False)   # crash/rolling-restart: no kStopServer up
+
+    # restart the party's server under the same global identity
+    loc2 = GeoPSServer(num_workers=1, mode="sync",
+                       global_addr=("127.0.0.1", gsrv.port),
+                       global_sender_id=1000, rank=1).start()
+    c2 = GeoPSClient(("127.0.0.1", loc2.port), sender_id=0)
+    c2.init("w", np.zeros(n, np.float32))
+    c2.push("w", np.full(n, 5.0, np.float32))
+    assert np.allclose(c2.pull("w"), 5.0)   # NOT the stale 1.0
+    c2.stop_server()
+    c2.close()
+
+
+def test_ts_dead_peer_fallback_completes_round():
+    """If a TS relay peer is unreachable, the sender sinks directly and
+    the scheduler rescues the stranded receiver: the round still
+    completes with the exact aggregate."""
+    server = GeoPSServer(num_workers=2, mode="sync", auto_pull=True).start()
+    ca = GeoPSClient(("127.0.0.1", server.port), sender_id=0,
+                     auto_pull=True, ts_node=1)
+    cb = GeoPSClient(("127.0.0.1", server.port), sender_id=1,
+                     auto_pull=True, ts_node=2)
+    n = 60
+    for c in (ca, cb):
+        c.init("w", np.zeros(n, np.float32))
+    # break B's relay listener: any A->B relay must fall back
+    cb._ts_listener.close()
+    ga = np.full(n, 1.0, np.float32)
+    gb = np.full(n, 2.0, np.float32)
+    ca.ts_push("w", ga)
+    cb.ts_push("w", gb)
+    out = ca.auto_pull("w", min_version=1, timeout=60.0)
+    np.testing.assert_allclose(out, ga + gb, rtol=1e-6)
+    for c in (ca, cb):
+        c.stop_server()
+        c.close()

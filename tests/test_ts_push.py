@@ -1,0 +1,160 @@
+"""TSEngine push-side (ASK1) relay aggregation — intra- and inter-party.
+
+Parity targets: workers finishing local aggregation ask the scheduler,
+which pairs them into a dynamic relay tree (lower-throughput node sends to
+the better-connected one); receivers merge-and-forward (WorkersMerge) and
+re-ask; the final holder sinks the merged aggregate at the server with a
+num_merge count covering everyone (kv_app.h:313-341, 586-691,
+kvstore_dist.h:91-169, van.cc:1238-1296).  ENABLE_INTER_TS runs the same
+machinery between local servers and the global tier.
+"""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.service import GeoPSClient, GeoPSServer
+from geomx_tpu.transport.tsengine import TSEngineScheduler
+
+
+def test_ask1_key_pairing_terminates_at_sink():
+    """W=3: two pairings then the last holder is directed to sink 0, and
+    the round state resets for the next round."""
+    s = TSEngineScheduler(4, seed=0)  # 0=sink, 1..3 workers
+    for rnd in range(3):  # repeated rounds reuse the state cleanly
+        d1 = s.ask1_key(1, "k", 3)
+        assert d1 is None
+        d2 = s.ask1_key(2, "k", 3)
+        assert d2 is not None and set(d2) == {1, 2}
+        sender, receiver = d2
+        d3 = s.ask1_key(3, "k", 3)
+        assert d3 is None  # queued, waiting for the merged holder
+        d4 = s.ask1_key(receiver, "k", 3)  # receiver merged, re-asks
+        assert d4 is not None and set(d4) == {3, receiver}
+        s2, r2 = d4
+        d5 = s.ask1_key(r2, "k", 3)
+        assert d5 == (r2, 0)  # final holder -> sink
+
+
+def test_ask1_key_dedups_queued_node():
+    s = TSEngineScheduler(3, seed=0)
+    assert s.ask1_key(1, "k", 2) is None
+    assert s.ask1_key(1, "k", 2) is None  # repeat ask while queued: ignored
+    d = s.ask1_key(2, "k", 2)
+    assert d is not None and set(d) == {1, 2}
+
+
+def test_ask1_orientation_prefers_measured_path():
+    """The node with the better measured path to its partner sends."""
+    s = TSEngineScheduler(3, seed=0)
+    s.report(1, 2, 100.0, 0)   # 1 -> 2 fast
+    s.report(2, 1, 1.0, 0)     # 2 -> 1 slow
+    s.ask1_key(1, "k", 2)
+    d = s.ask1_key(2, "k", 2)
+    assert d == (1, 2)
+
+
+def test_intra_ts_relay_aggregate_equals_direct_sum():
+    """3 workers ts_push; the relay tree must deliver exactly the direct
+    sum to the server, in a single sink push with num_merge=3, and
+    AutoPull must disseminate the result."""
+    server = GeoPSServer(num_workers=3, mode="sync", auto_pull=True).start()
+    clients = [GeoPSClient(("127.0.0.1", server.port), sender_id=i,
+                           auto_pull=True, ts_node=i + 1)
+               for i in range(3)]
+    n = 500
+    rng = np.random.RandomState(0)
+    grads = [rng.randn(n).astype(np.float32) for _ in range(3)]
+    for c in clients:
+        c.init("w", np.zeros(n, np.float32))
+    for c, g in zip(clients, grads):
+        c.ts_push("w", g)
+    outs = [c.auto_pull("w", min_version=1, timeout=30.0) for c in clients]
+    expect = np.sum(grads, axis=0)  # overwrite store: merged sum
+    for out in outs:
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+    # the aggregation tree collapsed everything into ONE sink push
+    pushes = [e for e in server.push_log if e[1] == "w"]
+    assert len(pushes) == 1, pushes
+    for c in clients:
+        c.stop_server()
+        c.close()
+
+
+def test_intra_ts_multiple_rounds_and_keys():
+    server = GeoPSServer(num_workers=2, mode="sync", auto_pull=True,
+                         accumulate=True).start()
+    clients = [GeoPSClient(("127.0.0.1", server.port), sender_id=i,
+                           auto_pull=True, ts_node=i + 1)
+               for i in range(2)]
+    n = 100
+    keys = ["a", "b"]
+    for c in clients:
+        for k in keys:
+            c.init(k, np.zeros(n, np.float32))
+    total = {k: np.zeros(n, np.float32) for k in keys}
+    rng = np.random.RandomState(1)
+    for rnd in range(1, 4):
+        gs = {k: [rng.randn(n).astype(np.float32) for _ in clients]
+              for k in keys}
+        for k in keys:
+            for c, g in zip(clients, gs[k]):
+                c.ts_push(k, g)
+            total[k] += np.sum(gs[k], axis=0)
+        for k in keys:
+            for c in clients:
+                out = c.auto_pull(k, min_version=rnd, timeout=30.0)
+                np.testing.assert_allclose(out, total[k],
+                                           rtol=1e-5, atol=1e-5)
+    for c in clients:
+        c.stop_server()
+        c.close()
+
+
+def test_inter_ts_matches_direct_hips(monkeypatch):
+    """2-party HiPS with ENABLE_INTER_TS: party aggregates relay-merge
+    across local servers before the global sink; final params must equal
+    the plain (direct-relay) topology's."""
+
+    def run(inter: bool):
+        if inter:
+            monkeypatch.setenv("GEOMX_ENABLE_INTER_TS", "1")
+        else:
+            monkeypatch.delenv("GEOMX_ENABLE_INTER_TS", raising=False)
+        gsrv = GeoPSServer(num_workers=2, mode="sync", rank=0).start()
+        locals_ = [GeoPSServer(num_workers=1, mode="sync",
+                               global_addr=("127.0.0.1", gsrv.port),
+                               global_sender_id=1000 + p, rank=1 + p).start()
+                   for p in range(2)]
+        cs = [GeoPSClient(("127.0.0.1", ls.port), sender_id=0)
+              for ls in locals_]
+        n = 80
+        for c in cs:
+            c.init("w", np.zeros(n, np.float32))
+        cs[0].set_optimizer("sgd", learning_rate=0.1)
+        cs[1].set_optimizer("sgd", learning_rate=0.1)
+
+        import threading
+        rng = np.random.RandomState(3)
+        rounds = [[rng.randn(n).astype(np.float32) for _ in cs]
+                  for _ in range(3)]
+        out = [None, None]
+        for gs in rounds:
+            ts = []
+            for i, (c, g) in enumerate(zip(cs, gs)):
+                def go(i=i, c=c, g=g):
+                    c.push("w", g)
+                    out[i] = c.pull("w", timeout=60.0)
+                t = threading.Thread(target=go)
+                t.start()
+                ts.append(t)
+            for t in ts:
+                t.join(timeout=90)
+        result = out[0].copy()
+        for c in cs:
+            c.stop_server()
+            c.close()
+        return result
+
+    direct = run(False)
+    ts = run(True)
+    np.testing.assert_allclose(ts, direct, rtol=1e-5, atol=1e-5)
