@@ -58,6 +58,7 @@ SYNC = env("GEOMX_SYNC_MODE", "fsa")
 HFA_K1 = env("GEOMX_HFA_K1", 20, int)  # local steps per local sync
 HFA_K2 = env("GEOMX_HFA_K2", 10, int)  # local syncs per global sync
 COMPRESSION = env("GEOMX_COMPRESSION", None)
+ENABLE_DGT = env("GEOMX_ENABLE_DGT", 0, int) or env("ENABLE_DGT", 0, int)
 EPOCHS = env("GEOMX_EPOCHS", 3, int)
 BATCH = env("GEOMX_BATCH", 64, int)
 LR = env("GEOMX_LR", 0.1, float)
@@ -183,6 +184,14 @@ def run_worker():
                                priority=-pr)
                     for k in sorted(params):
                         params[k] = c.pull(k)
+                continue
+            if ENABLE_DGT:
+                # DGT wire transport: contribution-ranked blocks, top-k
+                # first at f32, the rest low-priority fp16
+                for pr, k in enumerate(sorted(params)):
+                    c.push_dgt(k, np.asarray(g[k]), priority=-pr)
+                for k in sorted(params):
+                    params[k] = c.pull(k)
                 continue
             if intra_ts:
                 # announce partials to the ASK1 scheduler; the aggregate

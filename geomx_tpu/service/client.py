@@ -94,6 +94,8 @@ class GeoPSClient:
         # per-key push round ids: lets the server dedup a restarted
         # worker's replayed push exactly (see recover())
         self._key_rounds: Dict[str, int] = {}
+        # DGT per-key per-block contribution EWMAs (push_dgt)
+        self._dgt_contri: Dict[str, np.ndarray] = {}
         self._sock = connect_retry(addr)
         self._wlock = threading.Lock()
         # random rid base so a restarted worker reusing a sender_id cannot
@@ -351,6 +353,75 @@ class GeoPSClient:
         return self._submit(Msg(MsgType.PUSH, key=key, meta=m, array=g),
                             priority=priority)
 
+    def push_dgt(self, key: str, grad: np.ndarray, priority: int = 0,
+                 k: Optional[float] = None, block_elems: Optional[int] = None,
+                 channels: Optional[int] = None,
+                 alpha: Optional[float] = None, wait: bool = True,
+                 reliable: bool = False):
+        """DGT on the host wire (reference kv_app.h:1088-1196,
+        van.cc:723-846, re-expressed for a reliable transport): the
+        gradient is sliced into blocks, each block's contribution is an
+        EWMA of its mean |g|, and blocks ship as chunks whose *send
+        priority* follows contribution — the top round(k*nblocks) blocks
+        take the wire first at full precision (the reference's TCP channel
+        0), the rest queue behind them on descending 'channels' (its UDP
+        DSCP ladder) and are fp16-encoded (its low-bit encode()).  All
+        blocks are resend-protected, i.e. DGT-with-reliable-resend — the
+        convergence-safe configuration; the server reassembles via the
+        chunk path.  Defaults mirror DMLC_K=0.8, DGT_BLOCK_SIZE=4096B,
+        DMLC_UDP_CHANNEL_NUM=3, DGT_CONTRI_ALPHA=0.3."""
+        from geomx_tpu.config import _env
+        if k is None:
+            k = _env(("GEOMX_DGT_K", "DMLC_K"), 0.8, float)
+        if block_elems is None:
+            block_elems = _env(("GEOMX_DGT_BLOCK_ELEMS",), 1024, int)
+        if channels is None:
+            channels = _env(("GEOMX_UDP_CHANNEL_NUM",
+                             "DMLC_UDP_CHANNEL_NUM"), 3, int)
+        if alpha is None:
+            alpha = _env(("GEOMX_DGT_CONTRI_ALPHA", "DGT_CONTRI_ALPHA"),
+                         0.3, float)
+        g = np.asarray(grad, np.float32)
+        flat = g.reshape(-1)
+        n = flat.size
+        nb = max(1, -(-n // block_elems))
+        mag = np.array([np.abs(flat[b * block_elems:
+                                    (b + 1) * block_elems]).mean()
+                        for b in range(nb)], np.float32)
+        prev = self._dgt_contri.get(key)
+        contri = mag if prev is None else alpha * prev + (1 - alpha) * mag
+        self._dgt_contri[key] = contri
+        order = np.argsort(-contri, kind="stable")
+        kn = max(1, int(round(k * nb)))
+
+        rnd = self._key_rounds.get(key, 0) + 1
+        self._key_rounds[key] = rnd
+        rids = []
+        for rank, b in enumerate(np.asarray(order)):
+            start = int(b) * block_elems
+            stop = min(n, start + block_elems)
+            payload = flat[start:stop]
+            if rank < kn:
+                pr = priority + 1
+            else:
+                ch = 1 + (rank - kn) % max(1, channels)
+                pr = priority - ch
+                payload = payload.astype(np.float16)  # low-bit encode
+            m = {"chunk": int(b), "num_chunks": nb, "start": start,
+                 "n_total": n, "shape": list(g.shape), "round": rnd}
+            if reliable:
+                m["reliable"] = True  # e.g. the WAN relay hop: exempt
+                # from drop injection like every other relay message
+            rids.append(self._submit(
+                Msg(MsgType.PUSH, key=key, meta=m, array=payload),
+                priority=pr))
+        mrid = next(self._rid)
+        self._multi[mrid] = rids
+        if not wait:
+            return mrid
+        self.wait(mrid)
+        return None
+
     def pull(self, key: str, priority: int = 0,
              timeout: Optional[float] = 60.0,
              meta: Optional[dict] = None) -> np.ndarray:
@@ -383,6 +454,37 @@ class GeoPSClient:
             if remain is not None and remain <= 0:
                 raise TimeoutError(f"auto_pull({key!r}) timed out")
             ev.wait(remain if remain is None else min(remain, 1.0))
+
+    # ---- row-sparse path (reference EncodeRowSparseKey + dist push/pull,
+    # src/kvstore/kvstore_dist.h:874-906) --------------------------------
+
+    def push_row_sparse(self, key: str, row_ids, values,
+                        priority: int = 0) -> None:
+        """Push only the touched rows of a 2D+ parameter across the dist
+        plane: row ids travel in the header, row values as the payload —
+        the wire moves k rows, not the whole tensor."""
+        rows = np.asarray(row_ids, np.int64).ravel()
+        vals = np.asarray(values, np.float32)
+        vals = vals.reshape((len(rows),) + vals.shape[1:] if vals.ndim > 1
+                            else (len(rows),))
+        rnd = self._key_rounds.get(key, 0) + 1
+        self._key_rounds[key] = rnd
+        self.wait(self._submit(
+            Msg(MsgType.PUSH, key=key,
+                meta={"rows": [int(r) for r in rows], "round": rnd},
+                array=vals),
+            priority=priority))
+
+    def pull_row_sparse(self, key: str, row_ids,
+                        priority: int = 0,
+                        timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Pull only the requested rows (the reference's workers pull just
+        the embedding rows their batch touches)."""
+        rows = [int(r) for r in np.asarray(row_ids, np.int64).ravel()]
+        reply = self.wait(self._submit(
+            Msg(MsgType.PULL, key=key, meta={"rows": rows}),
+            priority=priority), timeout)
+        return np.asarray(reply.array, np.float32)
 
     def recover(self) -> Dict[str, int]:
         """Reconnect-and-resume for a restarted worker: fetch how many

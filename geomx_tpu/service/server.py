@@ -50,6 +50,10 @@ class _KeyState:
         # a WAN relay for this key failed: its round can never complete,
         # so pulls that would wait on it must fail fast with the reason
         self.relay_error: Optional[str] = None
+        # this round's row-sparse contributions, accumulated sparsely
+        # (densified at most once, at the round gate)
+        self.rs_rows: list = []
+        self.rs_vals: list = []
 
 
 class GeoPSServer:
@@ -188,6 +192,11 @@ class GeoPSServer:
             inter_ts = bool(env_int(("GEOMX_ENABLE_INTER_TS",
                                      "ENABLE_INTER_TS"), 0))
         self.inter_ts = inter_ts and compression is None
+        # DGT on the WAN hop (the reference's DataPushToGlobalServers ->
+        # DGT_Send path): uncompressed dense relays go through the global
+        # client's contribution-ranked block scheduler
+        self.enable_dgt = bool(env_int(("GEOMX_ENABLE_DGT", "ENABLE_DGT"),
+                                       0)) and compression is None
         self._global_ts_node = global_ts_node if global_ts_node is not None \
             else max(1, rank)
         self._ground: Dict[str, int] = {}   # key -> global rounds joined
@@ -610,37 +619,56 @@ class GeoPSServer:
         else:
             st.value = grad.astype(st.value.dtype)
 
-    def _placement(self, key: str, size: int) -> tuple:
+    def _placement(self, key: str, shape: tuple) -> dict:
         """Reference MultiGPS placement for the host plane: tensors >=
         bigarray_bound split contiguously across all global servers,
         smaller ones hashed whole (kvstore_dist.h:792-833; string keys
-        hash via crc32 in place of the reference's int keys).  Keys under
-        a dc-tier compressor are never split: their relay payloads are
-        compressed whole (value+index pairs are indivisible), so they
-        route to the hash owner."""
+        hash via crc32 in place of the reference's int keys).  Splits of
+        >=2-D tensors align to ROW boundaries, so row-sparse pushes route
+        per shard.  Keys under a dc-tier compressor are never split:
+        their relay payloads are compressed whole (value+index pairs are
+        indivisible), so they route to the hash owner."""
         import zlib
 
         from geomx_tpu.parallel.multigps import HASH_PRIME
         S = len(self._gclients)
+        size = int(np.prod(shape)) if shape else 1
         owner = (zlib.crc32(key.encode("utf-8")) * HASH_PRIME) % max(S, 1)
+        place = {"owner": owner, "bounds": None, "row_bounds": None,
+                 "shape": tuple(shape)}
         if S > 1 and self._compressor is None and \
                 size >= self.bigarray_bound:
-            per = size // S
-            bounds = tuple(i * per for i in range(S)) + (size,)
-            return -1, bounds
-        return owner, None
+            if len(shape) >= 2:
+                nrows = shape[0]
+                rowsize = size // nrows
+                per = nrows // S
+                rb = tuple(i * per for i in range(S)) + (nrows,)
+                place["row_bounds"] = rb
+                place["bounds"] = tuple(b * rowsize for b in rb)
+            else:
+                per = size // S
+                place["bounds"] = tuple(i * per for i in range(S)) + (size,)
+            place["owner"] = -1
+        return place
 
     def _global_init(self, key: str, value: np.ndarray) -> None:
-        """Place a key on the global tier (whole or sharded)."""
-        owner, bounds = self._placement(key, value.size)
-        self._gplace[key] = (owner, bounds)
-        if bounds is None:
-            self._gclients[owner].init(key, value, meta={"reliable": True})
+        """Place a key on the global tier (whole or sharded); row-aligned
+        shards keep the trailing row shape so row-sparse pushes work."""
+        place = self._placement(key, value.shape)
+        self._gplace[key] = place
+        if place["bounds"] is None:
+            self._gclients[place["owner"]].init(key, value,
+                                                meta={"reliable": True})
+            return
+        if place["row_bounds"] is not None:
+            rb = place["row_bounds"]
+            for i, c in enumerate(self._gclients):
+                c.init(key, value[rb[i]:rb[i + 1]], meta={"reliable": True})
             return
         flat = value.reshape(-1)
+        b = place["bounds"]
         for i, c in enumerate(self._gclients):
-            c.init(key, flat[bounds[i]:bounds[i + 1]],
-                   meta={"reliable": True})
+            c.init(key, flat[b[i]:b[i + 1]], meta={"reliable": True})
 
     def _relay_to_global(self, key: str, grad: np.ndarray) -> np.ndarray:
         """Push the party aggregate up, pull fresh globals back
@@ -651,24 +679,32 @@ class GeoPSServer:
     def _relay_to_global_impl(self, key: str, grad: np.ndarray) -> np.ndarray:
         place = self._gplace.get(key)
         if place is None:
-            place = (0, None) if len(self._gclients) == 1 \
-                else self._placement(key, grad.size)
-        owner, bounds = place
+            place = {"owner": 0, "bounds": None} \
+                if len(self._gclients) == 1 \
+                else self._placement(key, grad.shape)
+        owner, bounds = place["owner"], place["bounds"]
         if bounds is not None:
             # MultiGPS split relay: shard i goes to global server i (all
             # hops async, merged back on pull — the reference's multi-
             # server slicer + reassembly, kvstore_dist_server.h:1025-1082)
-            flat = np.asarray(grad, np.float32).reshape(-1)
-            ts = [c.push_async(key, flat[bounds[i]:bounds[i + 1]],
-                               meta={"reliable": True})
-                  for i, c in enumerate(self._gclients)]
+            rb = place.get("row_bounds")
+            if rb is not None:   # row-aligned: ship row-shaped shards
+                shards = [np.asarray(grad, np.float32)[rb[i]:rb[i + 1]]
+                          for i in range(len(self._gclients))]
+            else:
+                flat = np.asarray(grad, np.float32).reshape(-1)
+                shards = [flat[bounds[i]:bounds[i + 1]]
+                          for i in range(len(self._gclients))]
+            ts = [c.push_async(key, sh, meta={"reliable": True})
+                  for c, sh in zip(self._gclients, shards)]
             # bounded waits: a hung global server must raise and hit the
             # relay thread's fail-fast path, not wedge the FIFO forever
             for c, t in zip(self._gclients, ts):
                 c.wait(t, timeout=120.0)
             rids = [c.pull_async(key, meta={"reliable": True})
                     for c in self._gclients]
-            parts = [np.asarray(c.wait(r, timeout=120.0).array, np.float32)
+            parts = [np.asarray(c.wait(r, timeout=120.0).array,
+                                np.float32).reshape(-1)
                      for c, r in zip(self._gclients, rids)]
             return np.concatenate(parts).reshape(grad.shape)
         c0 = self._gclients[owner]
@@ -701,13 +737,89 @@ class GeoPSServer:
                         "shape": list(grad.shape)}
         elif self._compressor is not None and self._compressor.name == "fp16":
             payload = grad.astype(np.float16)
-        # the relay hop blocks under the store lock, so it opts out of
+        # the relay hop runs on the dedicated relay thread; it opts out of
         # drop injection (meta["reliable"])
         meta["reliable"] = True
         c = self._gclients[owner]
-        c.push(key, payload, meta=meta)
-        pulled = c.pull(key, meta={"reliable": True})
+        if self.enable_dgt and "comp" not in meta:
+            # WAN DGT: the party aggregate crosses as contribution-ranked
+            # priority blocks (top-k f32 first, fp16 tail)
+            c.push_dgt(key, payload, reliable=True)
+        else:
+            c.push(key, payload, meta=meta)
+        pulled = c.pull(key, timeout=120.0, meta={"reliable": True})
         return np.asarray(pulled, np.float32).reshape(grad.shape)
+
+    def _relay_row_sparse(self, key: str, rows, vals: np.ndarray):
+        """Push only the touched rows up, pull their fresh values back —
+        row-sparse through the dist path (kvstore_dist.h:874-906).
+        ``rows`` are unique and sorted, ``vals`` their summed values.
+        Hash-placed keys route whole; row-aligned split keys route each
+        row to its shard owner — and every server gets a push (possibly
+        empty) so multi-party sync counts stay in lockstep."""
+        rows_arr = np.asarray(rows, np.int64)
+        place = self._gplace.get(key)
+        with self.profiler.scope(f"RelayRowSparse:{key}", "comm"):
+            if place is None or place["owner"] >= 0:
+                c = self._gclients[place["owner"] if place else 0]
+                c.push_row_sparse(key, rows_arr, vals)
+                return c.pull_row_sparse(key, rows_arr, timeout=120.0)
+            rb = place.get("row_bounds")
+            if rb is None:
+                raise RuntimeError(
+                    f"row-sparse push for {key!r} but its MultiGPS split "
+                    "is not row-aligned (1-D tensors cannot take row-"
+                    "sparse pushes when split); raise GEOMX_BIGARRAY_BOUND")
+            fresh = np.empty_like(vals)
+            for i, c in enumerate(self._gclients):
+                mask = (rows_arr >= rb[i]) & (rows_arr < rb[i + 1])
+                c.push_row_sparse(key, rows_arr[mask] - rb[i], vals[mask])
+            for i, c in enumerate(self._gclients):
+                mask = (rows_arr >= rb[i]) & (rows_arr < rb[i + 1])
+                if mask.any():
+                    fresh[mask] = c.pull_row_sparse(
+                        key, rows_arr[mask] - rb[i], timeout=120.0)
+            return fresh
+
+    def _apply_row_sparse(self, key: str, rows, vals: np.ndarray):
+        """Lazy row-wise apply: only the touched rows of the value (and
+        of every row-shaped optimizer-state leaf) update — untouched rows
+        see no weight decay or momentum drift, the reference's row_sparse
+        optimizer semantics (src/operator/optimizer_op row_sparse
+        kernels).  ``rows`` unique, ``vals`` their summed gradients."""
+        st = self._store[key]
+        rows_arr = np.asarray(rows, np.int64)
+        if self._native_sgd is not None:
+            raise RuntimeError(
+                "row-sparse pushes need the optax optimizer path "
+                "(native SGD state is not row-addressable); set "
+                "GEOMX_NATIVE_SGD=0")
+        if self._tx is None:
+            v = st.value.copy()
+            np.add.at(v, rows_arr, vals)  # row-sparse accumulation
+            st.value = v
+            return
+        import jax
+        import jax.numpy as jnp
+        import optax
+        ridx = jnp.asarray(rows_arr)
+        ref = jnp.asarray(st.value)
+        shape = tuple(st.value.shape)
+
+        def is_rowwise(leaf):
+            return hasattr(leaf, "shape") and tuple(leaf.shape) == shape
+
+        state_rows = jax.tree.map(
+            lambda l: jnp.asarray(l)[ridx] if is_rowwise(l) else l,
+            self._opt_state[key])
+        updates, new_state_rows = self._tx.update(
+            jnp.asarray(vals), state_rows, ref[ridx])
+        st.value = np.asarray(
+            ref.at[ridx].set(optax.apply_updates(ref[ridx], updates)))
+        self._opt_state[key] = jax.tree.map(
+            lambda full, part: jnp.asarray(full).at[ridx].set(part)
+            if is_rowwise(full) else part,
+            self._opt_state[key], new_state_rows)
 
     def _decompress_incoming(self, msg: Msg) -> np.ndarray:
         if msg.meta.get("comp") == "bsc":
@@ -727,7 +839,24 @@ class GeoPSServer:
 
     def _handle_push_profiled(self, conn, msg: Msg):
         key = msg.key
-        grad = self._decompress_incoming(msg)
+        rs = None
+        if msg.meta.get("rows") is not None:
+            # row-sparse push (kvstore_dist.h:874-906): rows stay sparse
+            # through merge; they share the dense path's dedup machinery
+            with self._lock:
+                st = self._store.get(key)
+                if st is None:
+                    self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                        "error": f"no key {key}"}))
+                    return
+                tail = st.value.shape[1:]
+            rows = np.asarray(msg.meta["rows"], np.int64)
+            rs = (rows,
+                  np.asarray(msg.array, np.float32).reshape(
+                      (len(rows),) + tail))
+            grad = None
+        else:
+            grad = self._decompress_incoming(msg)
         # resend dedup: a push is not idempotent (it merges), so replayed
         # (sender, rid) signatures are re-ACKed without re-merging — the
         # reference Resender's signature set (src/resender.h).  Only
@@ -759,7 +888,7 @@ class GeoPSServer:
                 grad = full        # final chunk: merge the whole tensor;
                 # its ACK comes from _push_locked below
             try:
-                self._push_locked(conn, msg, key, grad)
+                self._push_locked(conn, msg, key, grad, rs=rs)
             except Exception:
                 if sig is not None:
                     self._seen_pushes.pop(sig, None)
@@ -792,12 +921,38 @@ class GeoPSServer:
             return None
         return part["buf"].reshape(part["shape"])
 
-    def _push_locked(self, conn, msg: Msg, key: str, grad: np.ndarray):
-        """The merge/apply body; caller holds self._lock."""
+    @staticmethod
+    def _rs_unique(rows_list, vals_list):
+        """Merge row-sparse contributions: unique rows, duplicates
+        summed.  Cost scales with the touched rows, not the tensor."""
+        rows_cat = np.concatenate(rows_list)
+        vals_cat = np.concatenate(vals_list)
+        uniq, inverse = np.unique(rows_cat, return_inverse=True)
+        vals_u = np.zeros((len(uniq),) + vals_cat.shape[1:], np.float32)
+        np.add.at(vals_u, inverse, vals_cat)
+        return uniq, vals_u
+
+    def _push_locked(self, conn, msg: Msg, key: str, grad, rs=None):
+        """The merge/apply body; caller holds self._lock.  ``rs`` is an
+        optional (row_ids, row_values) pair for a row-sparse push."""
         st = self._store[key]
+        if rs is not None and self.hfa_k2 is not None:
+            self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                "error": "row-sparse pushes do not compose with HFA "
+                         "(HFA workers push dense parameters)"}))
+            return
         if self.mode == "async":
             # arrival-ordered apply (DataHandleAsyncDefault)
-            if self._gclients:
+            if rs is not None:
+                rows_u, vals_u = self._rs_unique([rs[0]], [rs[1]])
+                if self._gclients:
+                    fresh = self._relay_row_sparse(key, rows_u, vals_u)
+                    v = st.value.copy()
+                    v[rows_u] = fresh
+                    st.value = v
+                else:
+                    self._apply_row_sparse(key, rows_u, vals_u)
+            elif self._gclients:
                 fresh = self._relay_to_global(key, grad)
                 st.value = fresh
             else:
@@ -823,7 +978,19 @@ class GeoPSServer:
                 int(r) <= st.pushed.get(msg.sender, 0):
             self._reply(conn, msg, Msg(MsgType.ACK, key=key))
             return
-        st.merged = grad if st.merged is None else st.merged + grad
+        # dense and row-sparse pushes must not mix within one sync round:
+        # the round gate would have to invent semantics for the overlap
+        if rs is not None and st.merged is not None or \
+                rs is None and st.rs_rows:
+            self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                "error": "dense and row-sparse pushes mixed in one sync "
+                         f"round for {key!r}"}))
+            return
+        if rs is not None:
+            st.rs_rows.append(rs[0])
+            st.rs_vals.append(rs[1])
+        else:
+            st.merged = grad if st.merged is None else st.merged + grad
         # a TS relay-merged push carries the contributions of num_merge
         # workers (reference KVMeta.num_merge counting toward the sync
         # gate, kvstore_dist_server.h:1324)
@@ -832,6 +999,15 @@ class GeoPSServer:
         self._reply(conn, msg, Msg(MsgType.ACK, key=key))
         if st.count >= self.num_workers:
             merged, st.merged, st.count = st.merged, None, 0
+            if st.rs_rows:
+                rows_u, vals_u = self._rs_unique(st.rs_rows, st.rs_vals)
+                st.rs_rows, st.rs_vals = [], []
+                if self._gclients:
+                    self._relay_q.put((key, (rows_u, vals_u), False, True))
+                    return
+                self._apply_row_sparse(key, rows_u, vals_u)
+                self._finish_round_locked(key, st)
+                return
             if self._gclients:
                 if self.hfa_k2 is not None:
                     # HFA: `merged` is the party-average parameters (workers
@@ -854,10 +1030,10 @@ class GeoPSServer:
                         # (ADVICE r2 #3); the round completes on install.
                         delta = (st.value.astype(np.float32) - st.milestone) \
                             / self.num_global_workers
-                        self._relay_q.put((key, delta, True))
+                        self._relay_q.put((key, delta, True, False))
                         return
                 else:
-                    self._relay_q.put((key, merged, False))
+                    self._relay_q.put((key, merged, False, False))
                     return
             else:
                 self._apply(key, merged)
@@ -868,10 +1044,11 @@ class GeoPSServer:
         it unblocks, feed the TS distributor.  Caller holds self._lock."""
         st.round += 1
         still = []
-        for c, rid, need in st.waiting_pulls:
+        for c, rid, need, rows in st.waiting_pulls:
             if st.round >= need:
-                reply = Msg(MsgType.PULL_REPLY, key=key,
-                            array=st.value)
+                val = st.value if rows is None else \
+                    st.value[np.asarray(rows, np.int64)]
+                reply = Msg(MsgType.PULL_REPLY, key=key, array=val)
                 if rid is not None:
                     reply.meta["rid"] = rid
                 try:
@@ -880,7 +1057,7 @@ class GeoPSServer:
                     pass  # dead waiter (crashed worker): drop its entry —
                     # the round must still complete for the live ones
             else:
-                still.append((c, rid, need))
+                still.append((c, rid, need, rows))
         st.waiting_pulls = still
         if self.ts_sched is not None:
             # hand an immutable snapshot to the distributor thread:
@@ -898,9 +1075,13 @@ class GeoPSServer:
             item = self._relay_q.get()
             if item is None:
                 return
-            key, payload, is_milestone = item
+            key, payload, is_milestone, is_rs = item
             try:
-                fresh = self._relay_to_global(key, payload)
+                if is_rs:
+                    rs_rows, rs_vals = payload
+                    fresh = self._relay_row_sparse(key, rs_rows, rs_vals)
+                else:
+                    fresh = self._relay_to_global(key, payload)
             except Exception as e:
                 # the round can never complete: fail current waiters fast
                 # with the reason, latch the error so pulls that arrive
@@ -916,7 +1097,7 @@ class GeoPSServer:
                         continue
                     st.relay_error = f"global relay failed: {e!r}"
                     waiters, st.waiting_pulls = st.waiting_pulls, []
-                for c, rid, _need in waiters:
+                for c, rid, _need, _rows in waiters:
                     err = Msg(MsgType.ERROR,
                               meta={"error": st.relay_error})
                     if rid is not None:
@@ -928,7 +1109,12 @@ class GeoPSServer:
                 continue
             with self._lock:
                 st = self._store[key]
-                st.value = fresh
+                if is_rs:
+                    v = st.value.copy()
+                    v[np.asarray(rs_rows, np.int64)] = fresh
+                    st.value = v
+                else:
+                    st.value = fresh
                 if is_milestone:
                     st.milestone = fresh.copy()
                 self._finish_round_locked(key, st)
@@ -1001,7 +1187,11 @@ class GeoPSServer:
                 if rid is None or all(
                         not (w[0] is conn and w[1] == rid)
                         for w in st.waiting_pulls):
-                    st.waiting_pulls.append((conn, rid, need))
+                    st.waiting_pulls.append((conn, rid, need,
+                                             msg.meta.get("rows")))
                 return
+            rows = msg.meta.get("rows")
+            val = st.value if rows is None else \
+                st.value[np.asarray(rows, np.int64)]
             self._reply(conn, msg, Msg(MsgType.PULL_REPLY, key=msg.key,
-                                       array=st.value))
+                                       array=val))

@@ -11,4 +11,10 @@ export GEOMX_ENABLE_DGT=2
 export GEOMX_DGT_K="${GEOMX_DGT_K:-0.8}"
 export GEOMX_UDP_CHANNEL_NUM="${GEOMX_UDP_CHANNEL_NUM:-3}"
 export GEOMX_ADAPTIVE_K="${GEOMX_ADAPTIVE_K:-1}"
+
+# host plane: workers push through the DGT wire scheduler (contribution-
+# ranked priority blocks, fp16 low channels) on the real PS topology
+"$(dirname "$0")/run_dist_ps.sh" "$@"
+
+# SPMD plane: in-graph deferred-aggregation DGT compressor
 run_on_cpu_mesh examples/cnn.py -d synthetic -ep 2 "$@"

@@ -1,0 +1,219 @@
+"""Row-sparse over the distributed PS plane + DGT on the host wire.
+
+Parity targets:
+- row-sparse dist push/pull (src/kvstore/kvstore_dist.h:874-906,
+  EncodeRowSparseKey): only touched rows cross the wire, duplicates
+  accumulate, the optimizer updates lazily per-row;
+- DGT host transport (3rdparty/ps-lite/src/van.cc:723-846,
+  kv_app.h:1088-1196): contribution-ranked blocks, the top k fraction
+  takes the wire first at full precision, the rest follow low-priority
+  and fp16-encoded, with reliable resend.
+"""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.service import GeoPSClient, GeoPSServer
+
+
+def test_row_sparse_dist_accumulate_and_pull_rows():
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    table = np.zeros((10, 4), np.float32)
+    c.init("emb", table)
+    rows = np.array([1, 3, 3])          # duplicate rows accumulate
+    vals = np.stack([np.full(4, 1.0), np.full(4, 2.0),
+                     np.full(4, 5.0)]).astype(np.float32)
+    c.push_row_sparse("emb", rows, vals)
+    got = c.pull_row_sparse("emb", [1, 3, 0])
+    np.testing.assert_allclose(got[0], 1.0)
+    np.testing.assert_allclose(got[1], 7.0)   # 2 + 5
+    np.testing.assert_allclose(got[2], 0.0)   # untouched
+    full = c.pull("emb")
+    assert np.allclose(full[[0, 2, 4]], 0.0)  # untouched rows intact
+    c.stop_server()
+    c.close()
+
+
+def test_row_sparse_dist_lazy_optimizer_rows_only():
+    """With a server-side optimizer, only touched rows (and their
+    momentum) move; untouched rows see no drift."""
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    table = np.ones((6, 3), np.float32)
+    c.init("emb", table)
+    import os
+    os.environ["GEOMX_NATIVE_SGD"] = "0"
+    try:
+        c.set_optimizer("momentum", learning_rate=0.5, momentum=0.9)
+        g = np.full((2, 3), 1.0, np.float32)
+        c.push_row_sparse("emb", [0, 2], g)
+        out = c.pull("emb")
+    finally:
+        del os.environ["GEOMX_NATIVE_SGD"]
+    np.testing.assert_allclose(out[[0, 2]], 1.0 - 0.5, rtol=1e-6)
+    np.testing.assert_allclose(out[[1, 3, 4, 5]], 1.0)  # untouched
+    c.stop_server()
+    c.close()
+
+
+def test_row_sparse_two_workers_sync_merge():
+    server = GeoPSServer(num_workers=2, mode="sync").start()
+    cs = [GeoPSClient(("127.0.0.1", server.port), sender_id=i)
+          for i in range(2)]
+    for c in cs:
+        c.init("emb", np.zeros((8, 2), np.float32))
+    import threading
+    def push(c, rows, v):
+        c.push_row_sparse("emb", rows, np.full((len(rows), 2), v,
+                                               np.float32))
+    t0 = threading.Thread(target=push, args=(cs[0], [1, 2], 1.0))
+    t1 = threading.Thread(target=push, args=(cs[1], [2, 5], 3.0))
+    t0.start(); t1.start(); t0.join(30); t1.join(30)
+    out = cs[0].pull("emb")
+    np.testing.assert_allclose(out[1], 1.0)
+    np.testing.assert_allclose(out[2], 4.0)    # both workers touched row 2
+    np.testing.assert_allclose(out[5], 3.0)
+    np.testing.assert_allclose(out[[0, 3, 4, 6, 7]], 0.0)
+    for c in cs:
+        c.stop_server()
+        c.close()
+
+
+def test_row_sparse_hips_relay_moves_rows_only():
+    """Two-tier: the local->global relay ships only the touched rows and
+    refreshes them from the global store."""
+    gsrv = GeoPSServer(num_workers=1, mode="sync", rank=0).start()
+    loc = GeoPSServer(num_workers=1, mode="sync",
+                      global_addr=("127.0.0.1", gsrv.port),
+                      global_sender_id=1000, rank=1).start()
+    c = GeoPSClient(("127.0.0.1", loc.port), sender_id=0)
+    c.init("emb", np.zeros((12, 2), np.float32))
+    c.push_row_sparse("emb", [4, 7], np.full((2, 2), 2.5, np.float32))
+    out = c.pull_row_sparse("emb", [4, 7, 0])
+    np.testing.assert_allclose(out[:2], 2.5)
+    np.testing.assert_allclose(out[2], 0.0)
+    # the global tier saw a row-sparse push, not a dense one
+    rs_pushes = [e for e in gsrv.push_log if e[1] == "emb"]
+    assert len(rs_pushes) == 1
+    np.testing.assert_allclose(gsrv._store["emb"].value[4], 2.5)
+    np.testing.assert_allclose(gsrv._store["emb"].value[0], 0.0)
+    c.stop_server()
+    c.close()
+
+
+# ---- DGT host wire -------------------------------------------------------
+
+def test_dgt_push_reassembles_with_fp16_tail():
+    """push_dgt: exact top-k blocks, fp16 for the rest, exact reassembly
+    ordering (high-contribution blocks first on the held wire)."""
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    n = 4096
+    block = 512
+    g = np.zeros(n, np.float32)
+    # blocks 0..7; give blocks 2 and 5 big magnitude (high contribution)
+    g[2 * block:3 * block] = 3.0
+    g[5 * block:6 * block] = -4.0
+    g[: block] = 0.001          # low-contribution tail
+    c.init("w", np.zeros(n, np.float32))
+
+    c.pause_sending()
+    t = c.push_dgt("w", g, k=0.25, block_elems=block, channels=2,
+                   wait=False)
+    c.resume_sending()
+    c.wait(t)
+    out = c.pull("w")
+
+    # fp16 rounding on the low blocks only
+    np.testing.assert_allclose(out[2 * block:3 * block], 3.0)
+    np.testing.assert_allclose(out[5 * block:6 * block], -4.0)
+    np.testing.assert_allclose(out, g.astype(np.float16).astype(np.float32),
+                               atol=1e-3)
+    # arrival order: the two high-contribution blocks beat the tail
+    # (ignoring the single frame the sender may hold before the gate)
+    order = [i for (_, k_, i) in server.push_log if k_ == "w"
+             and i is not None]
+    first_two = set(order[1:3]) if order[0] not in (2, 5) else \
+        set(order[:2])
+    assert first_two == {2, 5}, order
+    c.stop_server()
+    c.close()
+
+
+def test_dgt_push_survives_drops(monkeypatch):
+    """Every DGT block is resend-protected: 20% drops must yield exactly
+    the same stored value as a lossless run of the same pushes."""
+    def run(drop: bool):
+        if drop:
+            monkeypatch.setenv("GEOMX_DROP_MSG", "20")
+        else:
+            monkeypatch.delenv("GEOMX_DROP_MSG", raising=False)
+        server = GeoPSServer(num_workers=1, mode="sync",
+                             accumulate=True).start()
+        c = GeoPSClient(("127.0.0.1", server.port), sender_id=0,
+                        resend_timeout_ms=100)
+        n = 2048
+        c.init("w", np.zeros(n, np.float32))
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            c.push_dgt("w", rng.randn(n).astype(np.float32),
+                       block_elems=256)
+        out = c.pull("w")
+        c.stop_server()
+        c.close()
+        return out
+
+    clean = run(False)
+    dropped = run(True)
+    np.testing.assert_array_equal(clean, dropped)
+
+
+def test_dgt_contribution_ewma_persists():
+    """The EWMA must carry across pushes (van.cc contribution state)."""
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    n = 1024
+    c.init("w", np.zeros(n, np.float32))
+    g1 = np.zeros(n, np.float32)
+    g1[:256] = 10.0            # block 0 hot
+    c.push_dgt("w", g1, block_elems=256)
+    assert c._dgt_contri["w"].argmax() == 0
+    g2 = np.zeros(n, np.float32)
+    g2[768:] = 1.0             # block 3 mildly active
+    c.push_dgt("w", g2, block_elems=256)
+    # EWMA: block 0 still dominates after one quiet step (alpha=0.3)
+    assert c._dgt_contri["w"].argmax() == 0
+    c.stop_server()
+    c.close()
+
+
+def test_row_sparse_with_multigps_split():
+    """An embedding over bigarray_bound splits row-aligned across global
+    servers; row-sparse relays route each row to its shard owner and
+    multi-party sync counts stay in lockstep (every server gets a push)."""
+    gservers = [GeoPSServer(num_workers=1, mode="sync", rank=g)
+                for g in range(2)]
+    for g in gservers:
+        g.start()
+    loc = GeoPSServer(
+        num_workers=1, mode="sync",
+        global_addrs=[("127.0.0.1", g.port) for g in gservers],
+        global_sender_id=1000, bigarray_bound=40).start()
+    c = GeoPSClient(("127.0.0.1", loc.port), sender_id=0)
+    table = np.zeros((10, 8), np.float32)   # 80 elems >= bound 40
+    c.init("emb", table)
+    # shards are row-aligned: rows 0-4 on server 0, rows 5-9 on server 1
+    assert gservers[0]._store["emb"].value.shape == (5, 8)
+    assert gservers[1]._store["emb"].value.shape == (5, 8)
+    c.push_row_sparse("emb", [2, 7], np.stack(
+        [np.full(8, 1.5), np.full(8, 4.5)]).astype(np.float32))
+    out = c.pull_row_sparse("emb", [2, 7, 0])
+    np.testing.assert_allclose(out[0], 1.5)
+    np.testing.assert_allclose(out[1], 4.5)
+    np.testing.assert_allclose(out[2], 0.0)
+    # the rows landed on their shard owners
+    np.testing.assert_allclose(gservers[0]._store["emb"].value[2], 1.5)
+    np.testing.assert_allclose(gservers[1]._store["emb"].value[7 - 5], 4.5)
+    c.stop_server()
+    c.close()
