@@ -49,6 +49,11 @@ LOCAL_PORT = env("GEOMX_PS_PORT", 19800, int)  # + party_id
 # N global-server processes at GLOBAL_PORT..GLOBAL_PORT+N-1
 NUM_GLOBAL_SERVERS = env("GEOMX_NUM_GLOBAL_SERVERS", 1, int)
 GS_ID = env("GEOMX_GS_ID", 0, int)
+# central scheduler (reference ADD_NODE/Postoffice): with
+# GEOMX_USE_SCHEDULER=1 every process registers for a node id and
+# discovers peer addresses from the roster instead of env wiring
+USE_SCHEDULER = env("GEOMX_USE_SCHEDULER", 0, int)
+SCHED_PORT = env("GEOMX_SCHEDULER_PORT", 19600, int)
 # multi-host: where the tiers live (reference DMLC_PS_GLOBAL_ROOT_URI /
 # DMLC_PS_ROOT_URI; localhost for the pseudo-distributed mode)
 GLOBAL_HOST = (env("GEOMX_PS_GLOBAL_HOST")
@@ -67,6 +72,19 @@ LR = env("GEOMX_LR", 0.1, float)
 MODE = "async" if SYNC in ("mixed", "dist_async", "async") else "sync"
 
 
+def run_scheduler():
+    from geomx_tpu.service import GeoScheduler
+    sched = GeoScheduler(port=SCHED_PORT).start()
+    print(f"[scheduler] listening on {SCHED_PORT}", flush=True)
+    sched.join()
+    print("[scheduler] stopped", flush=True)
+
+
+def _sched_client():
+    from geomx_tpu.service import SchedulerClient
+    return SchedulerClient((GLOBAL_HOST, SCHED_PORT))
+
+
 def run_global_server():
     from geomx_tpu.service import GeoPSServer
     # HFA: the global store accumulates parties' milestone deltas onto the
@@ -75,18 +93,43 @@ def run_global_server():
     srv = GeoPSServer(port=port, num_workers=NUM_PARTIES,
                       mode=MODE, rank=GS_ID,
                       accumulate=(SYNC == "hfa")).start()
+    sc = None
+    if USE_SCHEDULER:
+        sc = _sched_client()
+        # advertise the address PEERS use to reach this node, not
+        # loopback — on multi-host deployments that is the launcher-set
+        # GLOBAL_HOST (this process runs on that host)
+        sc.register("global_server", host=GLOBAL_HOST, port=port,
+                    tag=str(GS_ID))
     print(f"[global_server {GS_ID}] listening on {port} "
           f"({NUM_PARTIES} parties, {MODE})", flush=True)
     srv.join()
+    if sc is not None:
+        if GS_ID == 0:   # last one out turns off the lights
+            sc.stop_scheduler()
+        sc.close()
     print(f"[global_server {GS_ID}] stopped", flush=True)
 
 
 def run_local_server():
     from geomx_tpu.service import GeoPSServer
     port = LOCAL_PORT + PARTY_ID
+    if USE_SCHEDULER:
+        # discover the global tier from the roster (sorted by node id, so
+        # every party sees the same shard order)
+        sc = _sched_client()
+        # LOCAL_HOST is this party's host (launcher sets GEOMX_PS_HOST
+        # per party for multi-host runs) — the address workers dial
+        sc.register("server", host=LOCAL_HOST, port=port,
+                    tag=str(PARTY_ID))
+        gaddrs = [(h, p) for (_id, h, p, _t) in
+                  sc.wait_for("global_server", NUM_GLOBAL_SERVERS)]
+        sc.close()
+    else:
+        gaddrs = [(GLOBAL_HOST, GLOBAL_PORT + i)
+                  for i in range(NUM_GLOBAL_SERVERS)]
     srv = GeoPSServer(port=port, num_workers=WORKERS_PER_PARTY, mode=MODE,
-                      global_addrs=[(GLOBAL_HOST, GLOBAL_PORT + i)
-                                    for i in range(NUM_GLOBAL_SERVERS)],
+                      global_addrs=gaddrs,
                       compression=COMPRESSION, rank=1 + PARTY_ID,
                       global_sender_id=1000 + PARTY_ID,
                       hfa_k2=HFA_K2 if SYNC == "hfa" else None,
@@ -120,14 +163,21 @@ def run_worker():
 
     from geomx_tpu.service import GeoPSClient
 
-    port = LOCAL_PORT + PARTY_ID
+    if USE_SCHEDULER:
+        # find THIS party's server through the roster instead of env math
+        sc = _sched_client()
+        entry = sc.wait_for("server", 1, tag=str(PARTY_ID))[0]
+        sc.close()
+        server_addr = (entry[1], entry[2])
+    else:
+        server_addr = (LOCAL_HOST, LOCAL_PORT + PARTY_ID)
     resend = env("PS_RESEND", 0, int)
     # intra-party TSEngine (ENABLE_INTRA_TS): push side joins the ASK1
     # relay overlay (ts_push), pull side consumes server-initiated
     # AutoPull updates — the reference's full TS data path
     intra_ts = bool(env("GEOMX_ENABLE_INTRA_TS", 0, int)
                     or env("ENABLE_INTRA_TS", 0, int))
-    c = GeoPSClient((LOCAL_HOST, port), sender_id=WORKER_ID,
+    c = GeoPSClient(server_addr, sender_id=WORKER_ID,
                     resend_timeout_ms=1000 if resend else None,
                     auto_pull=intra_ts,
                     ts_node=WORKER_ID + 1 if intra_ts else None)
@@ -236,6 +286,7 @@ def run_worker():
 
 
 if __name__ == "__main__":
-    {"global_server": run_global_server,
+    {"scheduler": run_scheduler,
+     "global_server": run_global_server,
      "server": run_local_server,
      "worker": run_worker}[ROLE]()

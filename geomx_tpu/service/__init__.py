@@ -14,5 +14,7 @@ send queues (P3), per-hop compression, and heartbeat liveness.
 from geomx_tpu.service.protocol import Msg, MsgType
 from geomx_tpu.service.server import GeoPSServer
 from geomx_tpu.service.client import GeoPSClient
+from geomx_tpu.service.scheduler import GeoScheduler, SchedulerClient
 
-__all__ = ["Msg", "MsgType", "GeoPSServer", "GeoPSClient"]
+__all__ = ["Msg", "MsgType", "GeoPSServer", "GeoPSClient",
+           "GeoScheduler", "SchedulerClient"]

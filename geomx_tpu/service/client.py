@@ -357,7 +357,8 @@ class GeoPSClient:
                  k: Optional[float] = None, block_elems: Optional[int] = None,
                  channels: Optional[int] = None,
                  alpha: Optional[float] = None, wait: bool = True,
-                 reliable: bool = False):
+                 reliable: bool = False,
+                 timeout: Optional[float] = 120.0):
         """DGT on the host wire (reference kv_app.h:1088-1196,
         van.cc:723-846, re-expressed for a reliable transport): the
         gradient is sliced into blocks, each block's contribution is an
@@ -419,8 +420,8 @@ class GeoPSClient:
         self._multi[mrid] = rids
         if not wait:
             return mrid
-        self.wait(mrid)
-        return None
+        self.wait(mrid, timeout)  # bounded: a hung server must raise,
+        return None               # not wedge the caller forever
 
     def pull(self, key: str, priority: int = 0,
              timeout: Optional[float] = 60.0,
@@ -459,7 +460,8 @@ class GeoPSClient:
     # src/kvstore/kvstore_dist.h:874-906) --------------------------------
 
     def push_row_sparse(self, key: str, row_ids, values,
-                        priority: int = 0) -> None:
+                        priority: int = 0,
+                        timeout: Optional[float] = 60.0) -> None:
         """Push only the touched rows of a 2D+ parameter across the dist
         plane: row ids travel in the header, row values as the payload —
         the wire moves k rows, not the whole tensor."""
@@ -473,7 +475,7 @@ class GeoPSClient:
             Msg(MsgType.PUSH, key=key,
                 meta={"rows": [int(r) for r in rows], "round": rnd},
                 array=vals),
-            priority=priority))
+            priority=priority), timeout)
 
     def pull_row_sparse(self, key: str, row_ids,
                         priority: int = 0,

@@ -1,0 +1,277 @@
+"""GeoScheduler — the central registration/discovery service.
+
+The reference's scheduler role (3rdparty/ps-lite/src/van.cc:41-163,
+postoffice.h:104-116): every node sends ADD_NODE at startup; the
+scheduler assigns node ids centrally (servers even, workers odd, starting
+at kOffset=100; global tier ids 8,10,... / 9,11,...), keeps the cluster
+roster, and on a node's re-registration marks it ``is_recovery`` and
+re-sends the cluster state (van.cc:165-212) so a restarted process can
+resume without a fresh barrier.
+
+Here the same capability as a small TCP service speaking the framework's
+COMMAND protocol:
+
+- ``register`` assigns an id per role (stable across re-registration:
+  the same (role, host, port) — or an explicit ``prev_id`` — gets its
+  old id back with ``is_recovery=True``), records the node's serving
+  address, and returns the current roster;
+- ``cluster`` returns the roster (role -> [(id, host, port, tag)];
+  ``tag`` carries e.g. the party id so workers can find THEIR server) —
+  how nodes discover each other instead of hard-wired env addressing;
+- ``barrier`` blocks until ``expect`` nodes enter (the per-tier Barrier);
+- heartbeats feed the shared dead-node detector.
+
+`scripts/launch.py` starts one per job when GEOMX_USE_SCHEDULER=1 and
+`examples/dist_ps.py` then discovers every address through it.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+from geomx_tpu.service.protocol import (Msg, MsgType, connect_retry,
+                                        recv_frame, send_frame)
+from geomx_tpu.utils.heartbeat import HeartbeatMonitor
+
+KOFFSET = 100  # reference base.h:36: intra-party ids start here
+
+
+class GeoScheduler:
+    """Role-based id assignment (servers even, workers odd — the
+    reference's scheme) + roster + barrier."""
+
+    def __init__(self, port: int = 0, bind_host: Optional[str] = None,
+                 heartbeat_timeout: float = 15.0):
+        self._lock = threading.Lock()
+        # (role, host, port) -> assigned id; survives re-registration
+        self._assigned: Dict[Tuple[str, str, int], int] = {}
+        self._roster: Dict[str, list] = {}   # role -> [(id, host, port)]
+        self._next = {"server": KOFFSET, "worker": KOFFSET + 1,
+                      "global_server": 8, "global_worker": 9}
+        self._barriers: Dict[str, list] = {}
+        self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if bind_host is None:
+            bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
+        self._srv.bind((bind_host, port))
+        self._srv.listen(64)
+        self._srv.settimeout(0.2)
+        self.port = self._srv.getsockname()[1]
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def join(self, timeout: Optional[float] = None):
+        self._thread.join(timeout)
+
+    # ---- service loop ------------------------------------------------------
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        while True:
+            try:
+                msg = recv_frame(conn)
+            except (OSError, pickle.UnpicklingError, ValueError):
+                return
+            if msg is None:
+                return
+            try:
+                if self._handle(conn, msg):
+                    return
+            except Exception as e:
+                self._reply(conn, msg, Msg(MsgType.ERROR,
+                                           meta={"error": repr(e)}))
+
+    def _reply(self, conn, req: Msg, reply: Msg):
+        rid = req.meta.get("rid")
+        if rid is not None:
+            reply.meta["rid"] = rid
+        send_frame(conn, reply)
+
+    def _handle(self, conn, msg: Msg) -> bool:
+        if msg.type == MsgType.HEARTBEAT:
+            if msg.sender >= 0:
+                self.heartbeats.heartbeat(msg.sender)
+            self._reply(conn, msg, Msg(MsgType.ACK))
+            return False
+        if msg.type == MsgType.STOP:
+            self._reply(conn, msg, Msg(MsgType.ACK))
+            self.stop()
+            return True
+        if msg.type != MsgType.COMMAND:
+            self._reply(conn, msg, Msg(MsgType.ERROR,
+                                       meta={"error": f"bad {msg.type}"}))
+            return False
+        cmd = msg.meta.get("cmd")
+        if cmd == "register":
+            role = msg.meta["role"]
+            host = msg.meta.get("host", "127.0.0.1")
+            port = int(msg.meta.get("port", 0))
+            tag = str(msg.meta.get("tag", ""))
+            prev = msg.meta.get("prev_id")
+            with self._lock:
+                key = (role, host, port)
+                node_id = self._assigned.get(key)
+                if node_id is None and prev is not None:
+                    # explicit recovery claim (e.g. restarted on a new
+                    # ephemeral port): take the old identity back
+                    for k, v in list(self._assigned.items()):
+                        if v == int(prev) and k[0] == role:
+                            del self._assigned[k]
+                            self._roster[role] = [
+                                e for e in self._roster.get(role, [])
+                                if e[0] != v]
+                            node_id = int(prev)
+                            break
+                recovery = node_id is not None and any(
+                    e[0] == node_id for e in self._roster.get(role, [])) \
+                    or (node_id is not None and prev is not None)
+                if node_id is None:
+                    node_id = self._next[role]
+                    self._next[role] += 2   # keep parity per role
+                self._assigned[(role, host, port)] = node_id
+                entries = [e for e in self._roster.setdefault(role, [])
+                           if e[0] != node_id]
+                entries.append((node_id, host, port, tag))
+                self._roster[role] = sorted(entries)
+                roster = {r: list(v) for r, v in self._roster.items()}
+            self.heartbeats.heartbeat(node_id)
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={
+                "node_id": node_id, "is_recovery": bool(recovery),
+                "cluster": roster}))
+        elif cmd == "cluster":
+            with self._lock:
+                roster = {r: list(v) for r, v in self._roster.items()}
+            self._reply(conn, msg, Msg(MsgType.ACK,
+                                       meta={"cluster": roster}))
+        elif cmd == "barrier":
+            group = str(msg.meta.get("group", ""))
+            expect = int(msg.meta["expect"])
+            with self._lock:
+                waiters = self._barriers.setdefault(group, [])
+                waiters.append((conn, msg.meta.get("rid")))
+                if len(waiters) >= expect:
+                    for c, rid in waiters:
+                        rel = Msg(MsgType.BARRIER_RELEASE)
+                        if rid is not None:
+                            rel.meta["rid"] = rid
+                        try:
+                            send_frame(c, rel)
+                        except OSError:
+                            pass
+                    self._barriers[group] = []
+        elif cmd == "num_dead_nodes":
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={
+                "dead": self.heartbeats.dead_nodes(
+                    msg.meta.get("timeout"))}))
+        else:
+            self._reply(conn, msg, Msg(MsgType.ERROR,
+                                       meta={"error": f"bad cmd {cmd}"}))
+        return False
+
+
+class SchedulerClient:
+    """A node's line to the scheduler: register, discover, barrier."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        self._sock = connect_retry(addr)
+        self._lock = threading.Lock()
+        self.node_id: Optional[int] = None
+        self.is_recovery = False
+
+    def _rpc(self, msg: Msg) -> Msg:
+        with self._lock:
+            send_frame(self._sock, msg)
+            reply = recv_frame(self._sock)
+        if reply is None:
+            raise ConnectionError("scheduler closed")
+        if reply.type == MsgType.ERROR:
+            raise RuntimeError(reply.meta.get("error", "scheduler error"))
+        return reply
+
+    def register(self, role: str, host: str = "127.0.0.1", port: int = 0,
+                 tag: str = "", prev_id: Optional[int] = None) -> dict:
+        reply = self._rpc(Msg(MsgType.COMMAND, meta={
+            "cmd": "register", "role": role, "host": host, "port": port,
+            "tag": tag,
+            **({"prev_id": prev_id} if prev_id is not None else {})}))
+        self.node_id = int(reply.meta["node_id"])
+        self.is_recovery = bool(reply.meta["is_recovery"])
+        return reply.meta
+
+    def cluster(self) -> dict:
+        return dict(self._rpc(Msg(MsgType.COMMAND,
+                                  meta={"cmd": "cluster"})).meta["cluster"])
+
+    def wait_for(self, role: str, count: int, timeout: float = 60.0,
+                 tag: Optional[str] = None) -> list:
+        """Poll the roster until `count` nodes of `role` (optionally with
+        the given tag) registered; returns them sorted by node id."""
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            entries = [e for e in self.cluster().get(role, [])
+                       if tag is None or (len(e) > 3 and e[3] == tag)]
+            if len(entries) >= count:
+                return sorted(entries)
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"only {len(entries)}/{count} {role} nodes registered")
+            time.sleep(0.1)
+
+    def barrier(self, group: str, expect: int,
+                timeout: float = 120.0) -> None:
+        old = self._sock.gettimeout()
+        self._sock.settimeout(timeout)
+        try:
+            reply = self._rpc(Msg(MsgType.COMMAND, meta={
+                "cmd": "barrier", "group": group, "expect": expect}))
+            if reply.type != MsgType.BARRIER_RELEASE:
+                raise ConnectionError(f"barrier failed: {reply}")
+        finally:
+            self._sock.settimeout(old)
+
+    def heartbeat(self) -> None:
+        msg = Msg(MsgType.HEARTBEAT)
+        msg.sender = self.node_id if self.node_id is not None else -1
+        self._rpc(msg)
+
+    def stop_scheduler(self) -> None:
+        try:
+            self._rpc(Msg(MsgType.STOP))
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

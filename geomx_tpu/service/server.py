@@ -759,10 +759,15 @@ class GeoPSServer:
         empty) so multi-party sync counts stay in lockstep."""
         rows_arr = np.asarray(rows, np.int64)
         place = self._gplace.get(key)
+        if place is None:
+            # e.g. after a local-server restart: recompute (and cache) the
+            # placement like the dense path, so split keys route correctly
+            place = self._placement(key, self._store[key].value.shape)
+            self._gplace[key] = place
         with self.profiler.scope(f"RelayRowSparse:{key}", "comm"):
-            if place is None or place["owner"] >= 0:
-                c = self._gclients[place["owner"] if place else 0]
-                c.push_row_sparse(key, rows_arr, vals)
+            if place["owner"] >= 0:
+                c = self._gclients[place["owner"]]
+                c.push_row_sparse(key, rows_arr, vals, timeout=120.0)
                 return c.pull_row_sparse(key, rows_arr, timeout=120.0)
             rb = place.get("row_bounds")
             if rb is None:
@@ -773,7 +778,8 @@ class GeoPSServer:
             fresh = np.empty_like(vals)
             for i, c in enumerate(self._gclients):
                 mask = (rows_arr >= rb[i]) & (rows_arr < rb[i + 1])
-                c.push_row_sparse(key, rows_arr[mask] - rb[i], vals[mask])
+                c.push_row_sparse(key, rows_arr[mask] - rb[i], vals[mask],
+                                  timeout=120.0)
             for i, c in enumerate(self._gclients):
                 mask = (rows_arr >= rb[i]) & (rows_arr < rb[i + 1])
                 if mask.any():

@@ -28,6 +28,11 @@ trap cleanup EXIT
 
 : "${GEOMX_NUM_GLOBAL_SERVERS:=1}"
 export GEOMX_NUM_GLOBAL_SERVERS
+if [[ "${GEOMX_USE_SCHEDULER:-0}" != "0" ]]; then
+  GEOMX_ROLE=scheduler python examples/dist_ps.py &
+  pids+=($!)
+  sleep 0.5
+fi
 for ((g = 0; g < GEOMX_NUM_GLOBAL_SERVERS; g++)); do
   GEOMX_ROLE=global_server GEOMX_GS_ID=$g python examples/dist_ps.py &
   pids+=($!)

@@ -136,7 +136,12 @@ def main():
         base["GEOMX_PS_BIND_HOST"] = "0.0.0.0"
 
     procs, workers = [], []
+    use_sched = os.environ.get("GEOMX_USE_SCHEDULER", "0") not in ("0", "")
     try:
+        if use_sched:
+            env = dict(base, GEOMX_ROLE="scheduler")
+            procs.append(spawn(cmd, env, global_host, "scheduler",
+                               launch_id))
         for g in range(args.num_global_servers):
             env = dict(base, GEOMX_ROLE="global_server", GEOMX_GS_ID=str(g))
             procs.append(spawn(cmd, env, global_host, f"global_server:{g}",
@@ -145,7 +150,10 @@ def main():
 
         for p in range(args.num_parties):
             host = party_hosts[p % len(party_hosts)]
-            env = dict(base, GEOMX_ROLE="server", GEOMX_PARTY_ID=str(p))
+            # GEOMX_PS_HOST doubles as the server's advertised address
+            # when scheduler discovery is on
+            env = dict(base, GEOMX_ROLE="server", GEOMX_PARTY_ID=str(p),
+                       GEOMX_PS_HOST=host or "127.0.0.1")
             procs.append(spawn(cmd, env, host, f"server:p{p}", launch_id))
         time.sleep(args.server_start_delay)
         # note: start ordering is best-effort; the service layer's

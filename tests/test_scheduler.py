@@ -1,0 +1,140 @@
+"""Central scheduler: node-id assignment, discovery, recovery.
+
+Parity target: the reference scheduler assigns node ids centrally
+(van.cc:41-163; servers even / workers odd from kOffset=100, global ids
+8,10,... per postoffice.h:104-116), re-registers recovering nodes with
+is_recovery and re-sends cluster state (van.cc:165-212), and runs the
+per-tier barriers.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from geomx_tpu.service import GeoScheduler, SchedulerClient
+
+
+def test_id_assignment_follows_reference_scheme():
+    sched = GeoScheduler().start()
+    addr = ("127.0.0.1", sched.port)
+    s0 = SchedulerClient(addr)
+    s1 = SchedulerClient(addr)
+    w0 = SchedulerClient(addr)
+    g0 = SchedulerClient(addr)
+    assert s0.register("server", port=1111)["node_id"] == 100
+    assert s1.register("server", port=1112)["node_id"] == 102
+    assert w0.register("worker", port=2221)["node_id"] == 101
+    assert g0.register("global_server", port=3331)["node_id"] == 8
+    roster = w0.cluster()
+    assert [e[0] for e in roster["server"]] == [100, 102]
+    assert roster["global_server"][0][:3] == (8, "127.0.0.1", 3331)
+    for c in (s0, s1, w0):
+        c.close()
+    g0.stop_scheduler()
+    g0.close()
+
+
+def test_recovery_reregistration_keeps_identity():
+    sched = GeoScheduler().start()
+    addr = ("127.0.0.1", sched.port)
+    a = SchedulerClient(addr)
+    info = a.register("worker", port=5000)
+    assert info["node_id"] == 101 and not info["is_recovery"]
+    a.close()
+    # same (role, host, port) re-registers: same id, flagged recovery,
+    # roster re-sent
+    b = SchedulerClient(addr)
+    info2 = b.register("worker", port=5000)
+    assert info2["node_id"] == 101 and info2["is_recovery"]
+    assert len(info2["cluster"]["worker"]) == 1
+    # restart on a NEW port claiming its previous id explicitly
+    c = SchedulerClient(addr)
+    info3 = c.register("worker", port=5999, prev_id=101)
+    assert info3["node_id"] == 101 and info3["is_recovery"]
+    assert [e[0] for e in c.cluster()["worker"]] == [101]
+    b.close()
+    c.stop_scheduler()
+    c.close()
+
+
+def test_barrier_and_wait_for():
+    sched = GeoScheduler().start()
+    addr = ("127.0.0.1", sched.port)
+    cs = [SchedulerClient(addr) for _ in range(3)]
+    order = []
+
+    def enter(i):
+        cs[i].register("worker", port=7000 + i)
+        cs[i].barrier("g1", expect=3)
+        order.append(i)
+
+    ts = [threading.Thread(target=enter, args=(i,)) for i in range(3)]
+    ts[0].start()
+    time.sleep(0.2)
+    assert not order            # barrier holds until all 3 enter
+    for t in ts[1:]:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert sorted(order) == [0, 1, 2]
+    got = cs[0].wait_for("worker", 3)
+    assert [e[0] for e in got] == [101, 103, 105]
+    cs[0].stop_scheduler()
+    for c in cs:
+        c.close()
+
+
+def test_discovery_end_to_end_training():
+    """Full HiPS job wired purely through the scheduler: servers register,
+    workers discover their party's server by tag, training converges."""
+    from geomx_tpu.service import GeoPSClient, GeoPSServer
+
+    sched = GeoScheduler().start()
+    saddr = ("127.0.0.1", sched.port)
+
+    gsrv = GeoPSServer(num_workers=2, mode="sync", rank=0).start()
+    g = SchedulerClient(saddr)
+    g.register("global_server", port=gsrv.port, tag="0")
+
+    locals_, regs = [], []
+    for p in range(2):
+        sc = SchedulerClient(saddr)
+        gaddr = [(h, pt) for (_i, h, pt, _t) in
+                 sc.wait_for("global_server", 1)]
+        ls = GeoPSServer(num_workers=1, mode="sync", global_addrs=gaddr,
+                         global_sender_id=1000 + p, rank=1 + p).start()
+        sc.register("server", port=ls.port, tag=str(p))
+        locals_.append(ls)
+        regs.append(sc)
+
+    outs = []
+    for p in range(2):
+        wc = SchedulerClient(saddr)
+        entry = wc.wait_for("server", 1, tag=str(p))[0]
+        wc.close()
+        c = GeoPSClient((entry[1], entry[2]), sender_id=0)
+        c.init("w", np.zeros(16, np.float32))
+        outs.append(c)
+
+    import threading as th
+    res = [None, None]
+
+    def round_(i):
+        outs[i].push("w", np.full(16, float(i + 1), np.float32))
+        res[i] = outs[i].pull("w", timeout=60.0)
+
+    ts = [th.Thread(target=round_, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    np.testing.assert_allclose(res[0], 3.0)   # 1 + 2 merged at the global
+    np.testing.assert_allclose(res[1], 3.0)
+    for c in outs:
+        c.stop_server()
+        c.close()
+    g.stop_scheduler()
+    g.close()
+    for sc in regs:
+        sc.close()
