@@ -52,6 +52,8 @@ class Trainer:
         self.eval_step, self._logits_fn = build_eval_step(model.apply)
         self._batch_sharding = topology.batch_sharding(self.mesh)
         self._epoch_runners: dict = {}
+        self._eval_cache: dict = {}    # device-resident test set
+        self._eval_sweeps: dict = {}   # batch_size -> scanned eval program
 
     def init_state(self, rng: jax.Array, sample_input: np.ndarray) -> TrainState:
         """sample_input: one local batch [b, H, W, C] (uint8 or float)."""
@@ -113,22 +115,59 @@ class Trainer:
 
     def evaluate(self, state: TrainState, x: np.ndarray, y: np.ndarray,
                  batch_size: int = 512) -> float:
+        """Test accuracy over (x, y): the dataset is cached on device on
+        first use and the whole sweep runs as ONE scanned program — one
+        dispatch and one scalar readback per call, instead of a host
+        round trip per batch (which dominates eval wall-clock on a
+        remote/tunneled chip)."""
         params = jax.tree.map(lambda a: a[0, 0], state.params)
         model_state = jax.tree.map(lambda a: a[0, 0], state.model_state)
-        correct, total = 0, 0
-        for i in range(0, len(x), batch_size):
-            # the ragged tail is padded (one extra compile at most) so every
-            # sample is scored — accuracy is the convergence observable
-            xb, yb = x[i:i + batch_size], y[i:i + batch_size]
-            pad = batch_size - len(xb)
-            if pad:
-                xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
-                yb = np.concatenate([yb, np.full((pad,), -1, yb.dtype)])
-            c, _ = self.eval_step(params, model_state,
-                                  jnp.asarray(xb), jnp.asarray(yb))
-            correct += int(c)
-            total += batch_size - pad
-        return correct / max(total, 1)
+        n = len(x)
+        # content-fingerprint cache key (not object identity, which a
+        # recycled id or in-place mutation would silently go stale on):
+        # all of y plus x strided down to <= ~4 MB.  A mutation confined
+        # to skipped x elements can evade the fingerprint; per-epoch eval
+        # sets are static in practice.
+        import hashlib
+        xa, ya = np.ascontiguousarray(x), np.ascontiguousarray(y)
+        stride = max(1, xa.nbytes // (4 << 20))
+        fp = hashlib.md5(xa[::stride].tobytes() + ya.tobytes()).hexdigest()
+        cache_key = (xa.shape, fp, batch_size)
+        cached = self._eval_cache.get(cache_key)
+        if cached is None:
+            pad = (-n) % batch_size
+            xp = np.concatenate(
+                [xa, np.zeros((pad,) + xa.shape[1:], xa.dtype)]) \
+                if pad else xa
+            yp = np.concatenate(
+                [ya, np.full((pad,), -1, ya.dtype)]) if pad else ya
+            cached = (jax.device_put(xp), jax.device_put(yp))
+            if len(self._eval_cache) >= 2:  # 2-slot LRU: train+test sets
+                self._eval_cache.pop(next(iter(self._eval_cache)))
+            self._eval_cache[cache_key] = cached
+        else:  # refresh LRU order
+            self._eval_cache[cache_key] = self._eval_cache.pop(cache_key)
+        dx, dy = cached
+
+        run = self._eval_sweeps.get(batch_size)
+        if run is None:
+            eval_step = self.eval_step
+            b = batch_size
+
+            @jax.jit
+            def run(params, model_state, dx, dy):
+                def body(acc, i):
+                    xb = jax.lax.dynamic_slice_in_dim(dx, i * b, b)
+                    yb = jax.lax.dynamic_slice_in_dim(dy, i * b, b)
+                    c, _ = eval_step(params, model_state, xb, yb)
+                    return acc + c, None
+                acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.int32),
+                                      jnp.arange(dx.shape[0] // b))
+                return acc
+
+            self._eval_sweeps[batch_size] = run
+        correct = int(run(params, model_state, dx, dy))
+        return correct / max(n, 1)
 
     def _epoch_runner(self, loader: GeoDataLoader):
         """One-dispatch-per-epoch runner: lax.scan over the epoch's steps

@@ -1,0 +1,116 @@
+"""Cross-validation of the two asynchrony implementations (VERDICT r2
+weak #8): the SPMD plane's MixedSync models staleness deterministically
+(pull_interval), the PS plane's async mode has true arrival-order
+asynchrony.  Both must solve the same learning problem — if either's
+asynchrony silently corrupted updates, its accuracy would collapse while
+the other's held.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+
+def _make_problem(n=1024, d=32, classes=5, seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.normal(size=(d, classes)).astype(np.float32)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+    return x, y
+
+
+def _acc(params, x, y):
+    logits = x @ params["w"] + params["b"]
+    return float((np.argmax(logits, 1) == y).mean())
+
+
+def _grads_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def grads(params, xb, yb):
+        def loss_fn(p):
+            logits = xb @ p["w"] + p["b"]
+            lse = jax.scipy.special.logsumexp(logits, axis=1)
+            return -(logits[jnp.arange(xb.shape[0]), yb] - lse).mean()
+        return jax.grad(loss_fn)(params)
+    return grads
+
+
+def test_ps_async_matches_spmd_mixedsync_learning():
+    """Same 2-worker logistic-regression job through (a) the PS plane's
+    true async server and (b) the SPMD MixedSync step; both reach the
+    same accuracy bar."""
+    from geomx_tpu.service import GeoPSClient, GeoPSServer
+
+    x, y = _make_problem()
+    d, classes = x.shape[1], 5
+    grads = _grads_fn()
+
+    # ---- (a) PS plane, true async: each worker pushes/pulls at its own
+    # pace against an arrival-ordered server with a server-side optimizer
+    server = GeoPSServer(num_workers=2, mode="async").start()
+    clients = [GeoPSClient(("127.0.0.1", server.port), sender_id=i)
+               for i in range(2)]
+    rng = np.random.RandomState(0)
+    init = {"w": (rng.normal(size=(d, classes)) * 0.01).astype(np.float32),
+            "b": np.zeros((classes,), np.float32)}
+    for c in clients:
+        for k, v in init.items():
+            c.init(k, v)
+    clients[0].set_optimizer("sgd", learning_rate=0.2)
+
+    def worker(wid):
+        import jax.numpy as jnp
+        params = {k: v.copy() for k, v in init.items()}
+        shard = slice(wid * 512, (wid + 1) * 512)
+        xs, ys = x[shard], y[shard]
+        perm_rng = np.random.RandomState(wid)
+        for step in range(60):
+            idx = perm_rng.randint(0, len(xs), size=64)
+            g = grads(params, jnp.asarray(xs[idx]), jnp.asarray(ys[idx]))
+            for k in params:
+                clients[wid].push(k, np.asarray(g[k]))
+            for k in params:
+                params[k] = clients[wid].pull(k)
+        return params
+
+    results = [None, None]
+    ts = [threading.Thread(target=lambda i=i: results.__setitem__(
+        i, worker(i))) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    ps_acc = _acc(results[0], x, y)
+    for c in clients:
+        c.stop_server()
+        c.close()
+
+    # ---- (b) SPMD plane, MixedSync staleness emulation
+    import jax
+    import optax
+
+    from geomx_tpu.models.mlp import MLP
+    from geomx_tpu.sync import MixedSync
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    trainer = Trainer(MLP(num_classes=classes, hidden=()),
+                      topo, optax.sgd(0.2), sync=MixedSync(pull_interval=2))
+    loader = trainer.make_loader(
+        (x.reshape(-1, 1, 1, d) * 1.0).astype(np.float32) * 255.0,
+        y, batch_size=64)
+    state = trainer.init_state(jax.random.PRNGKey(0),
+                               x[:2].reshape(-1, 1, 1, d) * 255.0)
+    state, _ = trainer.fit(state, loader, epochs=10)
+    logits = trainer.predict_logits(state, (x.reshape(-1, 1, 1, d)
+                                            * 255.0).astype(np.float32))
+    spmd_acc = float((np.argmax(logits, 1) == y).mean())
+
+    # both asynchrony models learn the same separable problem
+    assert ps_acc > 0.9, f"PS-plane async failed to learn: {ps_acc}"
+    assert spmd_acc > 0.9, f"SPMD MixedSync failed to learn: {spmd_acc}"
