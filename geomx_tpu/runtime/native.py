@@ -16,21 +16,41 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _stale() -> bool:
+    """The built .so predates the source (e.g. after a pull): rebuild."""
+    src = os.path.join(_NATIVE_DIR, "geops_runtime.cpp")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
+
+
 def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native runtime; None if unavailable."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and build:
+        if build and (not os.path.exists(_LIB_PATH) or _stale()):
             try:
-                subprocess.run(["make", "-C", _NATIVE_DIR],
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
                                check=True, capture_output=True, timeout=120)
             except (subprocess.SubprocessError, FileNotFoundError):
-                return None
+                pass  # fall through: a pre-existing .so may still bind
         if not os.path.exists(_LIB_PATH):
             return None
-        lib = ctypes.CDLL(_LIB_PATH)
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            return _bind(lib)
+        except (OSError, AttributeError):
+            # missing symbol = stale binary that could not be rebuilt:
+            # degrade to the pure-Python paths instead of crashing the
+            # capability probe (native_available)
+            return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+        global _lib
         # queue
         lib.gx_queue_create.restype = ctypes.c_void_p
         lib.gx_queue_destroy.argtypes = [ctypes.c_void_p]
@@ -59,6 +79,13 @@ def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
         lib.gx_ts_ask1.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                    ctypes.POINTER(ctypes.c_int)]
         lib.gx_ts_ask1.restype = ctypes.c_int
+        lib.gx_ts_ask1_key.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_int)]
+        lib.gx_ts_ask1_key.restype = ctypes.c_int
+        lib.gx_ts_drain_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_int)]
+        lib.gx_ts_drain_key.restype = ctypes.c_int
         lib.gx_ts_iters.argtypes = [ctypes.c_void_p]
         lib.gx_ts_iters.restype = ctypes.c_int64
         # sgd
@@ -166,6 +193,24 @@ class NativeTSEngine:
         if self._lib.gx_ts_ask1(self._ts, node, out):
             return int(out[0]), int(out[1])
         return None
+
+    def ask1_key(self, node: int, key,
+                 num_pushers: int) -> Optional[Tuple[int, int]]:
+        """Per-key ASK1 pairing with sink termination (same semantics as
+        TSEngineScheduler.ask1_key)."""
+        out = (ctypes.c_int * 2)()
+        if self._lib.gx_ts_ask1_key(self._ts, node,
+                                    str(key).encode("utf-8"),
+                                    num_pushers, out):
+            return int(out[0]), int(out[1])
+        return None
+
+    def drain_key(self, key) -> list:
+        """Abort a key's round; returns the still-queued nodes."""
+        out = (ctypes.c_int * self.n)()
+        n = self._lib.gx_ts_drain_key(self._ts, str(key).encode("utf-8"),
+                                      out)
+        return [int(out[i]) for i in range(n)]
 
     @property
     def iters(self) -> int:

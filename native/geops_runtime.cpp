@@ -17,6 +17,8 @@
 #include <cstring>
 #include <mutex>
 #include <queue>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -129,6 +131,11 @@ void gx_queue_close(void* q) {
 // TSEngine overlay scheduler
 // ---------------------------------------------------------------------------
 
+struct GxKeyRound {
+  std::vector<int> q;  // queued askers for this key's round
+  int pairs = 0;       // pairings completed this round
+};
+
 struct GxTs {
   int n;
   double max_greed;
@@ -139,6 +146,7 @@ struct GxTs {
   int64_t iters = 0;
   std::vector<int> ask_q;                 // push pairing queue
   std::vector<uint8_t> push_done;
+  std::unordered_map<std::string, GxKeyRound> key_rounds;  // per-key ASK1
   std::mutex mu;
 };
 
@@ -237,6 +245,53 @@ int gx_ts_ask1(void* p, int node, int* out) {
   out[0] = sender;
   out[1] = receiver;
   return 1;
+}
+
+// Per-key push pairing with sink termination (the ASK1 redesign the
+// Python scheduler uses: concurrent keys cannot cross-pair; after
+// num_pushers-1 pairings the last merged holder is directed to sink 0
+// and the round resets).  Returns 1 with {sender, receiver} in out, or
+// 0 when queued/duplicate.
+int gx_ts_ask1_key(void* p, int node, const char* key, int num_pushers,
+                   int* out) {
+  auto* ts = static_cast<GxTs*>(p);
+  std::lock_guard<std::mutex> lk(ts->mu);
+  auto& st = ts->key_rounds[std::string(key)];
+  for (int q : st.q)
+    if (q == node) return 0;  // duplicate ask while queued
+  if (st.pairs >= num_pushers - 1) {
+    st.pairs = 0;
+    st.q.clear();
+    out[0] = node;
+    out[1] = 0;
+    return 1;
+  }
+  st.q.push_back(node);
+  if (st.q.size() < 2) return 0;
+  int a = st.q[0], b = st.q[1];
+  st.q.erase(st.q.begin(), st.q.begin() + 2);
+  double ab = ts->A[a][b], ba = ts->A[b][a];
+  int sender = (ab > ba) ? a : b;
+  int receiver = (ab > ba) ? b : a;
+  st.pairs++;
+  out[0] = sender;
+  out[1] = receiver;
+  return 1;
+}
+
+// Abort a key's pairing round (a relay failed): every still-queued node
+// is returned in out (caller directs them to the sink) and the round
+// state resets.  Returns the count written (out must hold >= n ints).
+int gx_ts_drain_key(void* p, const char* key, int* out) {
+  auto* ts = static_cast<GxTs*>(p);
+  std::lock_guard<std::mutex> lk(ts->mu);
+  auto it = ts->key_rounds.find(std::string(key));
+  if (it == ts->key_rounds.end()) return 0;
+  int n = 0;
+  for (int q : it->second.q) out[n++] = q;
+  it->second.q.clear();
+  it->second.pairs = 0;
+  return n;
 }
 
 int64_t gx_ts_iters(void* p) {

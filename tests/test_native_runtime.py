@@ -145,3 +145,39 @@ def test_server_uses_native_sgd_when_available():
         c.close()
     finally:
         server.stop()
+
+
+def test_native_ask1_key_matches_python_scheduler():
+    """The C++ per-key ASK1 pairing follows the same state machine as
+    transport.TSEngineScheduler.ask1_key (pair, merge, sink, reset)."""
+    import pytest
+
+    from geomx_tpu.runtime.native import NativeTSEngine, native_available
+    from geomx_tpu.transport.tsengine import TSEngineScheduler
+
+    if not native_available():
+        pytest.skip("no native toolchain")
+    nat = NativeTSEngine(4, seed=1)
+    py = TSEngineScheduler(4, seed=1)
+    # identical measured-throughput state in both
+    for (s, r, t) in [(1, 2, 50.0), (2, 1, 10.0), (2, 3, 5.0), (3, 2, 9.0)]:
+        nat.report(s, r, t, 0)
+        py.report(s, r, t, 0)
+    for rnd in range(2):
+        for ask in [1, 2, 3]:
+            assert nat.ask1_key(ask, "k", 3) == py.ask1_key(ask, "k", 3)
+        # the receivers of the first pairing re-ask until the sink
+        d_n = nat.ask1_key(2, "k", 3)
+        d_p = py.ask1_key(2, "k", 3)
+        assert d_n == d_p
+        if d_n is not None and d_n[1] != 0:
+            assert nat.ask1_key(d_n[1], "k", 3) == \
+                py.ask1_key(d_p[1], "k", 3)
+
+    # drain aborts the round identically
+    nat2 = NativeTSEngine(4, seed=1)
+    py2 = TSEngineScheduler(4, seed=1)
+    assert nat2.ask1_key(1, "x", 3) is None
+    assert py2.ask1_key(1, "x", 3) is None
+    assert nat2.drain_key("x") == py2.drain_key("x") == [1]
+    assert nat2.drain_key("x") == py2.drain_key("x") == []
