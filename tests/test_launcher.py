@@ -34,3 +34,33 @@ def test_local_launch_end_to_end():
     # every worker reported accuracy and the servers stopped cleanly
     assert proc.stdout.count("test_acc") >= 2, proc.stdout
     assert "[global_server 0] stopped" in proc.stdout, proc.stdout
+
+
+def test_local_launch_with_scheduler_discovery():
+    """GEOMX_USE_SCHEDULER=1: the launcher spawns the scheduler role and
+    every process discovers peer addresses through it (the reference's
+    ADD_NODE flow) — end to end, plus MultiGPS sharding."""
+    env = dict(os.environ)
+    env.update({
+        "GEOMX_EPOCHS": "1",
+        "GEOMX_BATCH": "64",
+        "GEOMX_USE_SCHEDULER": "1",
+        "GEOMX_NUM_GLOBAL_SERVERS": "2",
+        "GEOMX_BIGARRAY_BOUND": "300",
+        "GEOMX_SCHEDULER_PORT": str(21000 + os.getpid() % 10000),
+        "GEOMX_PS_GLOBAL_PORT": str(33000 + os.getpid() % 10000),
+        "GEOMX_PS_PORT": str(45000 + os.getpid() % 10000),
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "scripts/launch.py",
+         "--num-parties", "2", "--workers-per-party", "1",
+         "--num-global-servers", "2",
+         "--server-start-delay", "0.5",
+         "--", sys.executable, "examples/dist_ps.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert proc.stdout.count("test_acc") >= 2, proc.stdout
+    assert "[scheduler] stopped" in proc.stdout, proc.stdout
+    assert "[global_server 1] stopped" in proc.stdout, proc.stdout
