@@ -1,0 +1,96 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second canonical long-context strategy next to ring attention
+(parallel/ring_attention.py): instead of rotating K/V blocks around a
+ring, one ``all_to_all`` re-shards the activations from
+sequence-sharding to HEAD-sharding, every device runs ordinary full
+attention over the complete sequence for its subset of heads, and a
+second ``all_to_all`` re-shards back.  Two collectives total per
+attention call (vs n-1 ppermute hops), full-sequence attention math on
+device (any masking/bias works unchanged), at the price of requiring
+num_heads % axis_size == 0.
+
+On TPU the all-to-alls ride ICI; composes with HiPS exactly like ring
+attention does: a 3-D mesh ("dc", "worker", "sp") runs hierarchical data
+parallelism across the first two axes and sequence parallelism along the
+third — use whichever of ring/ulysses fits the head count and sequence
+length.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from geomx_tpu.parallel.ring_attention import _block
+
+
+def _streaming_attention(q, k, v, causal: bool,
+                         block: int = 1024) -> jax.Array:
+    """Full-sequence attention with a flash-style streaming softmax over
+    K/V blocks: peak score memory is O(L * block) per head, never the
+    O(L^2) a dense softmax would materialize — this is the on-device
+    half of ulysses for the long sequences the module exists for."""
+    B, L, H, D = q.shape
+    blk = min(block, L)
+    nb = -(-L // blk)
+    pad = nb * blk - L
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    q_pos = jnp.arange(L)
+
+    m0 = jnp.full((B, H, L), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, L), jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+
+    def body(i, carry):
+        m, l, o = carry
+        kk = lax.dynamic_slice_in_dim(kf, i * blk, blk, axis=1)
+        vv = lax.dynamic_slice_in_dim(vf, i * blk, blk, axis=1)
+        k_pos = i * blk + jnp.arange(blk)
+        mask = k_pos[None, :] < L  # padded tail is never attended
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            mask = jnp.broadcast_to(mask, (L, blk))
+        return _block(qf, kk, vv, m, l, o, scale, mask)
+
+    m, l, o = lax.fori_loop(0, nb, body, (m0, l0, o0))
+    l = jnp.maximum(l, 1e-20)
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = False) -> jax.Array:
+    """Sequence-parallel attention via head/sequence all-to-all
+    re-sharding; call inside shard_map.
+
+    q, k, v: local blocks [B, L_local, H, D] (sequence sharded over
+    ``axis_name``); requires H % axis_size == 0.  Returns the local
+    output block [B, L_local, H, D], numerically identical to dense
+    attention over the full sequence.
+    """
+    n = lax.psum(1, axis_name)
+    B, Lq, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by the "
+                         f"sequence axis size ({n})")
+
+    # ONE all_to_all for q/k/v stacked: [3, B, L/n, H, D] -> [3, B, L,
+    # H/n, D] — each device trades its sequence shard of every head for
+    # the full sequence of its head shard (received chunks concatenate
+    # in device order = global sequence order)
+    qkv = lax.all_to_all(jnp.stack([q, k, v]), axis_name,
+                         split_axis=3, concat_axis=2, tiled=True)
+    out = _streaming_attention(qkv[0], qkv[1], qkv[2], causal)
+    # downcast BEFORE the return trip: all_to_all is pure data movement,
+    # so casting first is bit-identical and halves the wire bytes for
+    # sub-f32 activations.  [B, L, H/n, D] -> [B, L/n, H, D]
+    return lax.all_to_all(out.astype(q.dtype), axis_name,
+                          split_axis=1, concat_axis=2, tiled=True)
