@@ -155,9 +155,14 @@ class GeoPSServer:
         self._ap_ids: Dict[int, int] = {}     # sender id -> scheduler index
         self._ap_queue: "queue.Queue" = queue.Queue()
         self._ap_thread: Optional[threading.Thread] = None
-        # WAN relay jobs (key, payload, is_hfa_milestone) — see _relay_loop
-        self._relay_q: "queue.Queue" = queue.Queue()
-        self._relay_thread: Optional[threading.Thread] = None
+        # WAN relay workers: a bounded pool of FIFO shards with key-hash
+        # affinity — all of a key's jobs land on one shard (round order
+        # preserved) while distinct keys mostly proceed independently, so
+        # a straggler party's barrier on one key doesn't serialize the
+        # rest (the reference's per-key engine-async push-through) — see
+        # _relay_loop.  Lazily spawned; guarded by self._lock.
+        self._relay_shards = 8
+        self._relay_qs: Dict[int, "queue.Queue"] = {}
         # remotely-controllable profiler (reference kSetProfilerParams,
         # kvstore_dist_server.h:383-430)
         from geomx_tpu.utils.profiler import Profiler
@@ -237,9 +242,6 @@ class GeoPSServer:
                 # round ids where its dead incarnation left off, or the
                 # round-dedup would absorb all its future relays
                 c.recover()
-            self._relay_thread = threading.Thread(target=self._relay_loop,
-                                                  daemon=True)
-            self._relay_thread.start()
         self._accept_thread.start()
         if self.ts_sched is not None:
             self._ap_thread = threading.Thread(target=self._autopull_loop,
@@ -252,7 +254,9 @@ class GeoPSServer:
         sending kStopServer up — the rolling-restart/crash case, where a
         replacement server will re-register under the same identity."""
         self._running = False
-        self._relay_q.put(None)
+        with self._lock:
+            for q in self._relay_qs.values():
+                q.put(None)
         try:
             self._srv.close()
         except OSError:
@@ -1009,7 +1013,7 @@ class GeoPSServer:
                 rows_u, vals_u = self._rs_unique(st.rs_rows, st.rs_vals)
                 st.rs_rows, st.rs_vals = [], []
                 if self._gclients:
-                    self._relay_q.put((key, (rows_u, vals_u), False, True))
+                    self._relay_enqueue(key, ((rows_u, vals_u), False, True))
                     return
                 self._apply_row_sparse(key, rows_u, vals_u)
                 self._finish_round_locked(key, st)
@@ -1036,10 +1040,10 @@ class GeoPSServer:
                         # (ADVICE r2 #3); the round completes on install.
                         delta = (st.value.astype(np.float32) - st.milestone) \
                             / self.num_global_workers
-                        self._relay_q.put((key, delta, True, False))
+                        self._relay_enqueue(key, (delta, True, False))
                         return
                 else:
-                    self._relay_q.put((key, merged, False, False))
+                    self._relay_enqueue(key, (merged, False, False))
                     return
             else:
                 self._apply(key, merged)
@@ -1072,16 +1076,32 @@ class GeoPSServer:
             # mutates st.value in place on later rounds
             self._ap_queue.put((key, st.value.copy(), st.round))
 
-    def _relay_loop(self):
-        """Dedicated WAN-relay thread: the blocking push-through to the
-        global tier runs here, never under self._lock, so one straggling
-        party cannot freeze this server's pulls/pushes/heartbeats.  Jobs
-        are FIFO, preserving per-key round order."""
+    def _relay_enqueue(self, key: str, job: tuple):
+        """Queue a WAN relay job on the key's hash-affine worker shard
+        (lazily spawned, at most _relay_shards threads).  Caller holds
+        self._lock."""
+        if not self._running:
+            return  # racing a stop(): don't spawn a worker that would
+            # relay against closed global links and leak
+        import zlib
+        shard = zlib.crc32(key.encode("utf-8")) % self._relay_shards
+        q = self._relay_qs.get(shard)
+        if q is None:
+            q = self._relay_qs[shard] = queue.Queue()
+            threading.Thread(target=self._relay_loop, args=(q,),
+                             daemon=True).start()
+        q.put((key, job))
+
+    def _relay_loop(self, q: "queue.Queue"):
+        """WAN-relay worker: the blocking push-through to the global tier
+        runs here, never under self._lock, so one straggling party cannot
+        freeze this server's pulls/pushes/heartbeats.  Jobs are FIFO per
+        shard, preserving each key's round order."""
         while True:
-            item = self._relay_q.get()
+            item = q.get()
             if item is None:
                 return
-            key, payload, is_milestone, is_rs = item
+            key, (payload, is_milestone, is_rs) = item
             try:
                 if is_rs:
                     rs_rows, rs_vals = payload

@@ -327,3 +327,48 @@ def test_hfa_k2_reduces_global_relays():
     finally:
         local.stop()
         glob.stop()
+
+
+def test_straggler_party_does_not_stall_local_server():
+    """ADVICE r2 #3 regression: while party A's relay is parked at the
+    global tier waiting for a straggler party B, A's local server must
+    keep serving heartbeats, commands, and OTHER keys' full rounds (the
+    WAN hop runs on the relay thread, not under the server lock)."""
+    import numpy as np
+
+    gsrv = GeoPSServer(num_workers=2, mode="sync", rank=0).start()
+    la = GeoPSServer(num_workers=1, mode="sync",
+                     global_addr=("127.0.0.1", gsrv.port),
+                     global_sender_id=1000, rank=1).start()
+    lb = GeoPSServer(num_workers=1, mode="sync",
+                     global_addr=("127.0.0.1", gsrv.port),
+                     global_sender_id=1001, rank=2).start()
+    ca = GeoPSClient(("127.0.0.1", la.port), sender_id=0)
+    cb = GeoPSClient(("127.0.0.1", lb.port), sender_id=0)
+    n = 64
+    for c in (ca, cb):
+        c.init("slow", np.zeros(n, np.float32))
+        c.init("fast", np.zeros(n, np.float32))
+
+    # A pushes "slow"; its relay blocks at the global tier until B joins
+    t_slow = ca.push_async("slow", np.full(n, 1.0, np.float32))
+    ca.wait(t_slow)          # local merge ACKs immediately
+    time.sleep(0.3)          # relay thread is now parked at the WAN
+
+    # while parked: heartbeats, commands and a full OTHER-key round on A
+    t0 = time.monotonic()
+    ca.heartbeat()
+    assert ca.num_dead_nodes(timeout=60) == 0
+    ca.push("fast", np.full(n, 5.0, np.float32))
+    cb.push("fast", np.full(n, 7.0, np.float32))
+    out = ca.pull("fast", timeout=30.0)
+    assert time.monotonic() - t0 < 10.0, "local server stalled by straggler"
+    assert out.shape == (n,)
+
+    # the straggler arrives; the parked round completes correctly
+    cb.push("slow", np.full(n, 2.0, np.float32))
+    np.testing.assert_allclose(ca.pull("slow", timeout=30.0),
+                               cb.pull("slow", timeout=30.0))
+    for c in (ca, cb):
+        c.stop_server()
+        c.close()
