@@ -19,8 +19,8 @@ def test_local_launch_end_to_end():
         "GEOMX_BATCH": "64",
         # unique ports per run: back-to-back runs on fixed ports can
         # collide with a predecessor's lingering listener
-        "GEOMX_PS_GLOBAL_PORT": str(20000 + os.getpid() % 10000),
-        "GEOMX_PS_PORT": str(31000 + os.getpid() % 10000),
+        "GEOMX_PS_GLOBAL_PORT": str(20000 + os.getpid() % 2000),
+        "GEOMX_PS_PORT": str(23000 + os.getpid() % 2000),
         "JAX_PLATFORMS": "cpu",
     })
     env.pop("XLA_FLAGS", None)  # single-device CPU is fine for the workers
@@ -47,9 +47,9 @@ def test_local_launch_with_scheduler_discovery():
         "GEOMX_USE_SCHEDULER": "1",
         "GEOMX_NUM_GLOBAL_SERVERS": "2",
         "GEOMX_BIGARRAY_BOUND": "300",
-        "GEOMX_SCHEDULER_PORT": str(21000 + os.getpid() % 10000),
-        "GEOMX_PS_GLOBAL_PORT": str(33000 + os.getpid() % 10000),
-        "GEOMX_PS_PORT": str(45000 + os.getpid() % 10000),
+        "GEOMX_SCHEDULER_PORT": str(25000 + os.getpid() % 2000),
+        "GEOMX_PS_GLOBAL_PORT": str(27000 + os.getpid() % 2000),
+        "GEOMX_PS_PORT": str(29000 + os.getpid() % 2000),
         "JAX_PLATFORMS": "cpu",
     })
     env.pop("XLA_FLAGS", None)
