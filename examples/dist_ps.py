@@ -181,6 +181,14 @@ def run_worker():
                     resend_timeout_ms=1000 if resend else None,
                     auto_pull=intra_ts,
                     ts_node=WORKER_ID + 1 if intra_ts else None)
+    # resume round counters from any prior incarnation of this sender id:
+    # pushes carry per-key round ids and the server idempotently absorbs
+    # rounds it already merged, so a restarted worker that kept round=1
+    # would have every push silently deduped (ADVICE r3 #1)
+    prior = c.recover()
+    if any(prior.values()):
+        print(f"[worker p{PARTY_ID}w{WORKER_ID}] resuming: "
+              f"server has {sum(prior.values())} merged rounds", flush=True)
 
     d, classes = 64, 10
     x, y, xt, yt = make_data()

@@ -37,6 +37,11 @@ from geomx_tpu.service.protocol import (Msg, MsgType, connect_retry, env_int,
                                         recv_frame, send_frame)
 
 
+class _RelayConnectError(OSError):
+    """Relay connection could not be established — no bytes were sent, so
+    the partial may safely go elsewhere."""
+
+
 class _Pending:
     __slots__ = ("event", "reply", "frame", "priority")
 
@@ -121,6 +126,11 @@ class GeoPSClient:
         self._ts_lock = threading.Lock()
         self._ts_peers: Dict[Tuple[str, int], socket.socket] = {}
         self._ts_directives: "queue.Queue" = queue.Queue()
+        # relay frames carry a per-sender seq so a timed-out send can be
+        # RETRIED at the same peer (which dedups) instead of re-routed —
+        # re-routing a possibly-delivered partial would double-count it
+        self._relay_seq = itertools.count(1)
+        self._relay_seen: Dict[int, set] = {}
         if ts_node is not None:
             self._ts_listener = socket.socket(socket.AF_INET,
                                               socket.SOCK_STREAM)
@@ -135,7 +145,20 @@ class GeoPSClient:
                              daemon=True).start()
             threading.Thread(target=self._ts_dispatch_loop,
                              daemon=True).start()
-            adv = os.environ.get("GEOMX_RELAY_HOST", "127.0.0.1")
+            # advertise the address PEERS dial (ADVICE r3 #5): follow the
+            # listener's bind — a loopback-bound listener must advertise
+            # loopback (peers on this host), a wildcard-bound one (the
+            # launcher's multi-host setting) advertises this party's
+            # reachable host, and a concrete bind address advertises
+            # itself.  GEOMX_RELAY_HOST overrides.
+            adv = os.environ.get("GEOMX_RELAY_HOST")
+            if not adv:
+                if bind_host in ("127.0.0.1", "localhost", "::1"):
+                    adv = "127.0.0.1"
+                elif bind_host in ("0.0.0.0", "::"):
+                    adv = os.environ.get("GEOMX_PS_HOST") or "127.0.0.1"
+                else:
+                    adv = bind_host
             self._request(Msg(MsgType.COMMAND,
                               meta={"cmd": "ts_register", "node": ts_node,
                                     "host": adv, "port": self.relay_port}))
@@ -550,8 +573,22 @@ class GeoPSClient:
                 return
             if msg.type != MsgType.RELAY:
                 continue
-            self.ts_push(msg.key, msg.array,
-                         num_merge=int(msg.meta.get("num_merge", 1)))
+            # dedup by (sender node, seq): a peer whose ACK timed out
+            # retransmits the same frame (possibly on a fresh connection)
+            # — merge once, re-ACK always
+            frm, seq = msg.meta.get("from"), msg.meta.get("seq")
+            dup = False
+            if frm is not None and seq is not None:
+                with self._ts_lock:
+                    seen = self._relay_seen.setdefault(int(frm), set())
+                    dup = seq in seen
+                    if not dup:
+                        seen.add(seq)
+                        while len(seen) > 128:
+                            seen.discard(min(seen))
+            if not dup:
+                self.ts_push(msg.key, msg.array,
+                             num_merge=int(msg.meta.get("num_merge", 1)))
             try:
                 send_frame(conn, Msg(MsgType.ACK, key=msg.key))
             except OSError:
@@ -567,29 +604,52 @@ class GeoPSClient:
             with self._ts_lock:
                 buf = self._ts_buf.pop(key, None)
             if buf is None:
-                continue  # ghost directive: nothing buffered
+                # ghost directive: the buffer already shipped under an
+                # earlier pairing (a RELAY merge landed between the
+                # scheduler's decision and this pop).  The pairing consumed
+                # the designated receiver's ask, so without a rescue the
+                # receiver would never be directed again and the round
+                # stalls (ADVICE r3 #2) — tell the server so drain_key
+                # redirects the stranded receiver to the sink.
+                to = int(d.meta.get("to", 0))
+                if to != 0:
+                    self._notify_relay_failed(key, to)
+                continue
             arr, m = buf
             to = int(d.meta.get("to", 0))
             if to == 0:
                 self.push(key, arr, meta={"num_merge": m})
                 continue
             addr = (d.meta["host"], int(d.meta["port"]))
+            # one seq for every attempt at this partial: the receiver
+            # dedups retransmits by (from, seq)
+            seq = next(self._relay_seq)
+            retries = int(os.environ.get("GEOMX_RELAY_RETRIES", "3"))
             t0 = time.monotonic()
-            try:
-                self._relay_send(addr, key, arr, m)
-            except OSError:
-                # unreachable peer: sink our own partial directly AND tell
-                # the scheduler, which directs the stranded receiver (whose
-                # ask was consumed by this pairing) straight to the sink —
-                # otherwise its buffered partial never moves and the round
-                # cannot complete
-                self.push(key, arr, meta={"num_merge": m})
+            delivered = False
+            for _attempt in range(1 + retries):
                 try:
-                    self._request(Msg(MsgType.COMMAND, meta={
-                        "cmd": "ts_relay_failed", "key": key,
-                        "receiver": int(d.meta["to"])}))
-                except Exception:
-                    pass
+                    self._relay_send(addr, key, arr, m, seq)
+                    delivered = True
+                    break
+                except _RelayConnectError:
+                    break  # nothing was sent: safe to re-route at once
+                except OSError:
+                    # timeout OR reset after the frame went out: it may
+                    # already be delivered AND merged, so it must NEVER
+                    # be re-routed (that would double-count it at the
+                    # sink) — retry the SAME peer, which dedups by
+                    # (from, seq) on a fresh connection
+                    continue
+            if not delivered:
+                # unreachable (or persistently hung — presumed dead, its
+                # buffer lost with it): sink our own partial directly AND
+                # tell the scheduler, which directs the stranded receiver
+                # (whose ask was consumed by this pairing) straight to the
+                # sink — otherwise its buffered partial never moves and
+                # the round cannot complete
+                self.push(key, arr, meta={"num_merge": m})
+                self._notify_relay_failed(key, to)
                 continue
             dt = max(time.monotonic() - t0, 1e-9)
             try:  # throughput feedback steers future pairings
@@ -599,12 +659,37 @@ class GeoPSClient:
             except Exception:
                 pass
 
-    def _relay_send(self, addr, key: str, arr: np.ndarray, m: int):
+    def _notify_relay_failed(self, key: str, receiver: int) -> None:
+        """Best-effort: tell the scheduler a pairing broke so drain_key
+        redirects the stranded receiver (and the rest of the round's
+        queue) to the sink."""
+        try:
+            self._request(Msg(MsgType.COMMAND, meta={
+                "cmd": "ts_relay_failed", "key": key,
+                "receiver": receiver}))
+        except Exception:
+            pass
+
+    def _relay_send(self, addr, key: str, arr: np.ndarray, m: int,
+                    seq: Optional[int] = None):
         sock = self._ts_peers.get(addr)
         if sock is None:
-            sock = connect_retry(addr, total_timeout_s=10.0)
+            try:
+                sock = connect_retry(addr, total_timeout_s=10.0)
+            except OSError as e:
+                # no frame left this host: the caller may re-route the
+                # partial without any double-count risk
+                raise _RelayConnectError(str(e)) from e
+            # a peer that accepted but hung must raise (socket.timeout is
+            # an OSError) rather than wedge the single dispatch thread
+            # forever (ADVICE r3 #4); the dispatcher retries the same
+            # (from, seq) frame so a slow-but-alive peer dedups
+            sock.settimeout(float(os.environ.get(
+                "GEOMX_RELAY_TIMEOUT_S", "30")))
             self._ts_peers[addr] = sock
-        msg = Msg(MsgType.RELAY, key=key, meta={"num_merge": m}, array=arr)
+        msg = Msg(MsgType.RELAY, key=key,
+                  meta={"num_merge": m, "from": self.ts_node, "seq": seq},
+                  array=arr)
         msg.sender = self.sender_id
         try:
             send_frame(sock, msg)

@@ -881,15 +881,25 @@ class GeoPSServer:
             if len(self.push_log) > 65536:
                 del self.push_log[:32768]
             if sig is not None:
-                if sig in self._seen_pushes:
+                prior = self._seen_pushes.get(sig)
+                if prior is True:
                     self._reply(conn, msg, Msg(MsgType.ACK, key=key))
+                    return
+                if prior == "parked":
+                    # original is queued on the relay shard (async mode):
+                    # not installed yet, so a retransmit must NOT be
+                    # ACKed — stay silent; the deferred reply (same rid)
+                    # answers whichever copy the client is waiting on
                     return
                 # check-and-record atomically so concurrent replays can't
                 # both merge; rolled back below if processing fails so a
                 # retransmit can still succeed
                 self._seen_pushes[sig] = True
                 while len(self._seen_pushes) > 65536:
-                    self._seen_pushes.pop(next(iter(self._seen_pushes)))
+                    k0 = next(iter(self._seen_pushes))
+                    if self._seen_pushes[k0] == "parked":
+                        break  # never evict an in-flight signature
+                    self._seen_pushes.pop(k0)
             if msg.meta.get("chunk") is not None:
                 full = self._p3_accumulate(msg, grad)
                 if full is None:   # more chunks outstanding
@@ -898,7 +908,7 @@ class GeoPSServer:
                 grad = full        # final chunk: merge the whole tensor;
                 # its ACK comes from _push_locked below
             try:
-                self._push_locked(conn, msg, key, grad, rs=rs)
+                self._push_locked(conn, msg, key, grad, rs=rs, sig=sig)
             except Exception:
                 if sig is not None:
                     self._seen_pushes.pop(sig, None)
@@ -942,9 +952,13 @@ class GeoPSServer:
         np.add.at(vals_u, inverse, vals_cat)
         return uniq, vals_u
 
-    def _push_locked(self, conn, msg: Msg, key: str, grad, rs=None):
+    def _push_locked(self, conn, msg: Msg, key: str, grad, rs=None,
+                     sig=None):
         """The merge/apply body; caller holds self._lock.  ``rs`` is an
-        optional (row_ids, row_values) pair for a row-sparse push."""
+        optional (row_ids, row_values) pair for a row-sparse push.
+        ``sig`` is the push's resend-dedup signature: an async-mode relay
+        parks it until the relayed value installs, so retransmits of the
+        in-flight push are neither re-merged nor falsely ACKed."""
         st = self._store[key]
         if rs is not None and self.hfa_k2 is not None:
             self._reply(conn, msg, Msg(MsgType.ERROR, meta={
@@ -952,19 +966,28 @@ class GeoPSServer:
                          "(HFA workers push dense parameters)"}))
             return
         if self.mode == "async":
-            # arrival-ordered apply (DataHandleAsyncDefault)
+            # arrival-ordered apply (DataHandleAsyncDefault).  The WAN
+            # push-through runs on the key-affine relay shard, never
+            # inline under self._lock (a straggling global tier would
+            # stall every other key, pulls and heartbeats for up to the
+            # relay timeout — ADVICE r3 #3); the pusher is ACKed after
+            # the fresh value installs.
             if rs is not None:
                 rows_u, vals_u = self._rs_unique([rs[0]], [rs[1]])
                 if self._gclients:
-                    fresh = self._relay_row_sparse(key, rows_u, vals_u)
-                    v = st.value.copy()
-                    v[rows_u] = fresh
-                    st.value = v
-                else:
-                    self._apply_row_sparse(key, rows_u, vals_u)
+                    if sig is not None:
+                        self._seen_pushes[sig] = "parked"
+                    self._relay_enqueue(
+                        key,
+                        ((rows_u, vals_u), False, True, (conn, msg, sig)))
+                    return
+                self._apply_row_sparse(key, rows_u, vals_u)
             elif self._gclients:
-                fresh = self._relay_to_global(key, grad)
-                st.value = fresh
+                if sig is not None:
+                    self._seen_pushes[sig] = "parked"
+                self._relay_enqueue(
+                    key, (grad, False, False, (conn, msg, sig)))
+                return
             else:
                 self._apply(key, grad)
             st.round += 1
@@ -1013,7 +1036,8 @@ class GeoPSServer:
                 rows_u, vals_u = self._rs_unique(st.rs_rows, st.rs_vals)
                 st.rs_rows, st.rs_vals = [], []
                 if self._gclients:
-                    self._relay_enqueue(key, ((rows_u, vals_u), False, True))
+                    self._relay_enqueue(
+                        key, ((rows_u, vals_u), False, True, None))
                     return
                 self._apply_row_sparse(key, rows_u, vals_u)
                 self._finish_round_locked(key, st)
@@ -1040,10 +1064,10 @@ class GeoPSServer:
                         # (ADVICE r2 #3); the round completes on install.
                         delta = (st.value.astype(np.float32) - st.milestone) \
                             / self.num_global_workers
-                        self._relay_enqueue(key, (delta, True, False))
+                        self._relay_enqueue(key, (delta, True, False, None))
                         return
                 else:
-                    self._relay_enqueue(key, (merged, False, False))
+                    self._relay_enqueue(key, (merged, False, False, None))
                     return
             else:
                 self._apply(key, merged)
@@ -1101,7 +1125,11 @@ class GeoPSServer:
             item = q.get()
             if item is None:
                 return
-            key, (payload, is_milestone, is_rs) = item
+            # ``reply_to`` is (conn, request) for an async-mode push whose
+            # ACK is deferred until the relayed value installs; None for
+            # sync-mode rounds (their ACKs went out at merge time and the
+            # round completes via _finish_round_locked)
+            key, (payload, is_milestone, is_rs, reply_to) = item
             try:
                 if is_rs:
                     rs_rows, rs_vals = payload
@@ -1117,6 +1145,22 @@ class GeoPSServer:
                 import sys
                 print(f"[geomx-ps rank {self.rank}] global relay failed "
                       f"for {key!r}: {e!r}", file=sys.stderr, flush=True)
+                if reply_to is not None:
+                    # async mode: the pusher is still waiting — fail its
+                    # request directly instead of latching the key, and
+                    # roll the parked dedup signature back so a fresh
+                    # retransmit re-merges instead of vanishing
+                    if reply_to[2] is not None:
+                        with self._lock:
+                            self._seen_pushes.pop(reply_to[2], None)
+                    try:
+                        self._reply(reply_to[0], reply_to[1],
+                                    Msg(MsgType.ERROR, meta={
+                                        "error": f"global relay failed: "
+                                                 f"{e!r}"}))
+                    except OSError:
+                        pass
+                    continue
                 with self._lock:
                     st = self._store.get(key)
                     if st is None:
@@ -1143,7 +1187,24 @@ class GeoPSServer:
                     st.value = fresh
                 if is_milestone:
                     st.milestone = fresh.copy()
-                self._finish_round_locked(key, st)
+                if reply_to is None:
+                    self._finish_round_locked(key, st)
+                else:
+                    # async mode: arrival-ordered round bump + TSEngine
+                    # dissemination, mirroring the non-relay apply path;
+                    # the parked dedup signature completes — retransmits
+                    # are idempotently ACKed from here on
+                    if reply_to[2] is not None:
+                        self._seen_pushes[reply_to[2]] = True
+                    st.round += 1
+                    if self.ts_sched is not None:
+                        self._ap_queue.put((key, st.value.copy(), st.round))
+            if reply_to is not None:
+                try:
+                    self._reply(reply_to[0], reply_to[1],
+                                Msg(MsgType.ACK, key=key))
+                except OSError:
+                    pass  # pusher died; the install stands
 
     def _autopull_loop(self):
         while self._running or not self._ap_queue.empty():

@@ -372,3 +372,53 @@ def test_straggler_party_does_not_stall_local_server():
     for c in (ca, cb):
         c.stop_server()
         c.close()
+
+
+def test_async_relay_runs_off_lock_and_off_serve_thread():
+    """ADVICE r3 #3 regression: in ASYNC mode the WAN push-through must
+    run on the relay shard, not inline under the server lock — while
+    party A's relay of "slow" is parked at a sync global tier waiting for
+    party B, A's server must keep answering heartbeats, commands, and a
+    full round of an OTHER key from the SAME client connection.  The
+    pusher's ACK is deferred until the relayed value installs."""
+    gsrv = GeoPSServer(num_workers=2, mode="sync", rank=0).start()
+    la = GeoPSServer(num_workers=1, mode="async",
+                     global_addr=("127.0.0.1", gsrv.port),
+                     global_sender_id=1000, rank=1).start()
+    lb = GeoPSServer(num_workers=1, mode="async",
+                     global_addr=("127.0.0.1", gsrv.port),
+                     global_sender_id=1001, rank=2).start()
+    ca = GeoPSClient(("127.0.0.1", la.port), sender_id=0)
+    cb = GeoPSClient(("127.0.0.1", lb.port), sender_id=0)
+    n = 64
+    # "slow" and "fast" hash to different relay shards (5 and 4 of 8), so
+    # the parked "slow" relay cannot FIFO-block the "fast" one
+    for c in (ca, cb):
+        c.init("slow", np.zeros(n, np.float32))
+        c.init("fast", np.zeros(n, np.float32))
+
+    # A's push of "slow" relays immediately (async mode) and parks at the
+    # sync global tier until B contributes; the ACK is deferred
+    t_slow = ca.push_async("slow", np.full(n, 1.0, np.float32))
+    time.sleep(0.3)
+
+    # while parked: the SAME connection keeps being served
+    t0 = time.monotonic()
+    ca.heartbeat()
+    assert ca.num_dead_nodes(timeout=60) == 0
+    t_fa = ca.push_async("fast", np.full(n, 5.0, np.float32))
+    t_fb = cb.push_async("fast", np.full(n, 7.0, np.float32))
+    ca.wait(t_fa, timeout=30.0)
+    cb.wait(t_fb, timeout=30.0)
+    out = ca.pull("fast", timeout=30.0)
+    assert time.monotonic() - t0 < 10.0, "async relay stalled the server"
+    np.testing.assert_allclose(out, 12.0)
+
+    # the straggler arrives: the parked push ACKs and both parties agree
+    cb.push("slow", np.full(n, 2.0, np.float32), meta=None)
+    ca.wait(t_slow, timeout=30.0)
+    np.testing.assert_allclose(ca.pull("slow", timeout=30.0),
+                               cb.pull("slow", timeout=30.0))
+    for c in (ca, cb):
+        c.stop_server()
+        c.close()

@@ -158,3 +158,40 @@ def test_inter_ts_matches_direct_hips(monkeypatch):
     direct = run(False)
     ts = run(True)
     np.testing.assert_allclose(ts, direct, rtol=1e-5, atol=1e-5)
+
+
+def test_ghost_directive_rescues_stranded_receiver():
+    """ADVICE r3 #2 regression: a directive can pair a node whose buffer
+    already shipped under an earlier directive (a RELAY merge landed
+    between the scheduler's decision and the dispatcher's pop).  The
+    pairing consumed the receiver's ask, so the sender must notify the
+    server, which drains the round to the sink — otherwise the receiver's
+    buffered partial never moves and the round stalls to timeout."""
+    from geomx_tpu.service.protocol import Msg, MsgType
+
+    server = GeoPSServer(num_workers=2, mode="sync", auto_pull=True).start()
+    a = GeoPSClient(("127.0.0.1", server.port), sender_id=0,
+                    auto_pull=True, ts_node=1)
+    b = GeoPSClient(("127.0.0.1", server.port), sender_id=1,
+                    auto_pull=True, ts_node=2)
+    n = 64
+    g_a = np.full(n, 3.0, np.float32)
+    g_b = np.full(n, 5.0, np.float32)
+    for c in (a, b):
+        c.init("w", np.zeros(n, np.float32))
+    # b announces a partial; with 2 registered overlay nodes the scheduler
+    # queues the ask, waiting for a partner
+    b.ts_push("w", g_b)
+    # a's contribution reached the sink under an EARLIER directive (the
+    # race's first half) — emulated by a direct push
+    a.push("w", g_a, meta={"num_merge": 1})
+    # ...and the stale queued ask now pairs a (empty buffer) with b: a
+    # ghost.  The rescue must redirect b (whose ask was consumed by this
+    # pairing) to the sink, or the round stalls to timeout.
+    a._ts_directives.put(Msg(MsgType.TS_DIRECTIVE, key="w",
+                             meta={"to": 2}))
+    out = b.auto_pull("w", min_version=1, timeout=20.0)
+    np.testing.assert_allclose(out, g_a + g_b)
+    for c in (a, b):
+        c.stop_server()
+        c.close()
