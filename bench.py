@@ -30,9 +30,10 @@ Env knobs:
   GEOMX_BENCH_INIT_ATTEMPTS  fresh-child init attempts (default 3)
   GEOMX_BENCH_TIMEOUT        seconds for measurement after init
                              (default 3000)
-  GEOMX_BENCH_TTA=1          also run time-to-accuracy (CIFAR10 if
-                             present under GEOMX_DATA_DIR, else synthetic)
-  GEOMX_BENCH_TTA_TARGET     test-acc target (default 0.92 real / 0.70 syn)
+  GEOMX_BENCH_TTA=0          skip time-to-accuracy (runs by default:
+                             real CIFAR10 when present/fetchable under
+                             GEOMX_DATA_DIR, else the synthetic proxy)
+  GEOMX_BENCH_TTA_TARGET     test-acc target (default 0.92 real / 0.90 syn)
 """
 
 import json
@@ -88,9 +89,16 @@ def _build_configs(n_devices: int):
         ("bsc", {"sync_mode": "fsa", "compression": "bsc,0.01"}, parties),
         # examples/cnn_fp16.py / cnn_mpq.py — fp16 / mixed-precision comm
         ("fp16_mpq", {"sync_mode": "fsa", "compression": "mpq,0.01"}, parties),
-        # examples/cnn_hfa.py — HFA + DGT priority transport
+        # examples/cnn_hfa.py — HFA + DGT priority transport.  3 deferral
+        # channels (reference scripts/cpu/run_dgt.sh runs
+        # DMLC_UDP_CHANNEL_NUM=3) with k=0.5: non-drain steps move the
+        # top half of the blocks, every 3rd step drains — amortized wire
+        # ~(0.5*2+1)/3 = 67% of dense, so the deferral is visible in
+        # wire_bytes_per_sync (VERDICT r3: channels=1 made every step a
+        # drain and DGT deferred nothing)
         ("hfa_dgt", {"sync_mode": "hfa", "hfa_k1": 20, "hfa_k2": 10,
-                     "enable_dgt": 2, "compression": "none"}, parties),
+                     "enable_dgt": 2, "udp_channel_num": 3, "dgt_k": 0.5,
+                     "compression": "none"}, parties),
     ]
 
 
@@ -163,6 +171,15 @@ def _measure_config(name, overrides, parties, batch, iters, peak):
         wire = {"compressed": int(comp.wire_bytes(params)),
                 "dense_fp32": int(sum(l.size * 4
                                       for l in jax.tree.leaves(params)))}
+        # every accelerator config must actually reduce the WAN payload —
+        # a "compression" config whose wire equals dense is a misconfig
+        # (VERDICT r3: hfa_dgt with 1 channel deferred nothing)
+        if comp.name != "none":
+            wire["reduces"] = wire["compressed"] < wire["dense_fp32"]
+            assert wire["reduces"], (
+                f"{name}: compressed wire bytes {wire['compressed']} !< "
+                f"dense {wire['dense_fp32']} — config defers/compresses "
+                "nothing")
 
     return {
         "config": name,
@@ -178,56 +195,100 @@ def _measure_config(name, overrides, parties, batch, iters, peak):
 
 def _microbench_kernels(peak, on_tpu: bool):
     """Compression-kernel microbench: Pallas vs jnp 2-bit quantize, exact
-    vs approx BSC top-k (VERDICT r1 #7: prove the Pallas path).
+    vs approx BSC top-k (VERDICT r1 #7 / r3 #1: prove the Pallas path).
 
-    Each candidate runs as ONE jitted lax.scan of `iters` dependent
-    applications, so a single dispatch amortizes the host->device round
-    trip and the per-iteration number is device time — a per-call loop
-    from the host measures mostly dispatch RTT on a tunneled chip."""
+    Methodology (r4): each candidate runs as a jitted lax.scan of
+    dependent applications whose FULL outputs are consumed into the
+    carry, and the reported per-iteration time is the SLOPE between a
+    low and a high iteration count (min over reps, value-fetched).  Two
+    failure modes of the r3 methodology are closed: (a) on a tunneled
+    chip a single dispatch costs 30-80ms of noisy RTT, which at 50
+    iterations swamped the tens-of-µs kernels — the slope cancels the
+    fixed cost exactly; (b) carrying only the residual let XLA
+    dead-code-eliminate the jnp path's packing (the opaque pallas_call
+    can't be DCE'd), making the comparison unfair — summing the packed
+    words into the carry forces both paths to do the full job.
+
+    Note on the roofline: at 4M f32 the working set (input + carry,
+    32 MB) is VMEM-resident across scan iterations on a 128 MB-VMEM
+    chip, so per-iteration times can beat the naive HBM roofline; the
+    numbers are compute/VMEM-bound kernel times, the right regime for
+    a fused compression kernel."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    n = 4 * 1024 * 1024
-    iters = 50
+    n = 4 * 1024 * 1024 if on_tpu else 1024 * 1024
+    lo, hi, reps = (1000, 5000, 5) if on_tpu else (4, 16, 3)
     g = jnp.asarray(np.random.RandomState(0).randn(n), jnp.float32)
     res = jnp.zeros((n,), jnp.float32)
-    out = {}
+    out = {"method": f"scan-slope iters {lo}->{hi}, min of {reps}, "
+                     "outputs consumed", "elements": n}
 
-    def _time_scanned(step, init_carry):
-        """step: carry -> carry with the kernel inside; one dispatch."""
-        @jax.jit
-        def run(c):
-            return jax.lax.scan(lambda cc, _: (step(cc), None), c,
-                                None, length=iters)[0]
-        jax.block_until_ready(run(init_carry))  # compile
-        t0 = time.perf_counter()
-        jax.block_until_ready(run(init_carry))
-        return (time.perf_counter() - t0) / iters
+    def _slope(step, init_carry, lo=lo, hi=hi):
+        """Per-iteration seconds: slope of total time vs scan length."""
+        tot = {}
+        for iters in (lo, hi):
+            @jax.jit
+            def run(c, iters=iters):
+                c = jax.lax.scan(lambda cc, _: (step(cc), None), c,
+                                 None, length=iters)[0]
+                return jax.tree.map(jnp.sum, c)
+            # compile + one throwaway fetch
+            jax.tree.map(lambda a: float(a), run(init_carry))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.tree.map(lambda a: float(a), run(init_carry))
+                ts.append(time.perf_counter() - t0)
+            tot[iters] = min(ts)
+        return max(0.0, (tot[hi] - tot[lo]) / (hi - lo))
 
     from geomx_tpu.compression.twobit import TwoBitCompressor
     jnp_q = TwoBitCompressor(0.5, use_pallas=False).quantize
+    z32 = jnp.zeros((), jnp.int32)
 
-    # the error-feedback residual is the natural loop carry: every
-    # iteration's input differs, so nothing hoists out of the scan
-    out["twobit_jnp_ms"] = round(
-        _time_scanned(lambda r: jnp_q(g, r)[1], res) * 1e3, 4)
+    # the error-feedback residual carries; the packed words fold into an
+    # int accumulator so neither path's pack can be eliminated
+    def _jnp_step(c):
+        r, acc = c
+        packed, newr = jnp_q(g, r)
+        return newr, acc + jnp.sum(packed)
+    out["twobit_jnp_ms"] = round(_slope(_jnp_step, (res, z32)) * 1e3, 4)
     if on_tpu:
         try:
-            from geomx_tpu.ops import quantize_2bit
+            from geomx_tpu.ops import dequantize_2bit, quantize_2bit
+
+            def _pallas_step(c):
+                r, acc = c
+                packed, newr = quantize_2bit(g, r, 0.5)
+                return newr, acc + jnp.sum(packed)
             out["twobit_pallas_ms"] = round(
-                _time_scanned(lambda r: quantize_2bit(g, r, 0.5)[1],
-                              res) * 1e3, 4)
+                _slope(_pallas_step, (res, z32)) * 1e3, 4)
+            packed0, _ = quantize_2bit(g, res, 0.5)
+            packed0 = jax.block_until_ready(packed0)
+
+            # the carry XORs into the packed words so the dequant input
+            # depends on the previous iteration — loop-invariant code
+            # motion cannot hoist the kernel out of the scan
+            def _dequant_step(c):
+                s, acc = c
+                vals = dequantize_2bit(packed0 ^ s, n, 0.5)
+                return (1 - s), acc + jnp.sum(vals)
+            out["twobit_dequant_pallas_ms"] = round(_slope(
+                _dequant_step, (z32, jnp.zeros(()))) * 1e3, 4)
         except Exception as e:
             out["twobit_pallas_error"] = repr(e)
 
     k = n // 100
     # carry the vector through a tiny perturbation so each top_k input
-    # depends on the previous iteration (no CSE/hoisting)
-    out["bsc_topk_exact_ms"] = round(_time_scanned(
+    # depends on the previous iteration (no CSE/hoisting); fold the
+    # selected values in so the selection itself can't be eliminated
+    out["bsc_topk_exact_ms"] = round(_slope(
         lambda v: v * (1.0 + 1e-12 * jax.lax.top_k(
-            jnp.abs(v), k)[0][0]), g) * 1e3, 4)
-    out["bsc_topk_approx_ms"] = round(_time_scanned(
+            jnp.abs(v), k)[0][0]), g,
+        lo=max(1, lo // 5), hi=max(2, hi // 5)) * 1e3, 4)
+    out["bsc_topk_approx_ms"] = round(_slope(
         lambda v: v * (1.0 + 1e-12 * jax.lax.approx_max_k(
             jnp.abs(v), k)[0][0]), g) * 1e3, 4)
     return out
@@ -235,8 +296,11 @@ def _microbench_kernels(peak, on_tpu: bool):
 
 def _time_to_accuracy(batch):
     """Train the flagship to the target test accuracy; wall-clock seconds.
-    Uses real CIFAR10 when present under GEOMX_DATA_DIR, else the
-    learnable synthetic set (recorded in the result)."""
+    The north star is time-to-92% on REAL CIFAR-10 (BASELINE.md): the
+    dataset is fetched in-run when the environment has egress
+    (tools/fetch_cifar10.py); a no-egress environment falls back to the
+    synthetic proxy at a 0.90 target, and the result records both the
+    fallback and the denial reason."""
     import jax
     import numpy as np
     import optax
@@ -247,12 +311,22 @@ def _time_to_accuracy(batch):
     from geomx_tpu.topology import HiPSTopology
     from geomx_tpu.train import Trainer
 
-    data = load_dataset("cifar10", root=os.environ.get("GEOMX_DATA_DIR",
-                                                       "/root/data"),
-                        synthetic_train_n=8192)
+    root = os.environ.get("GEOMX_DATA_DIR", "/root/data")
+    fetch_note = None
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        import fetch_cifar10
+        if not fetch_cifar10.ensure(root, quiet=True):
+            fetch_note = ("cifar10 absent and download failed (no egress "
+                          "in this environment); synthetic proxy used — "
+                          "run tools/fetch_cifar10.py where network exists")
+    except Exception as e:
+        fetch_note = f"fetch_cifar10 unavailable: {e!r}"
+    data = load_dataset("cifar10", root=root, synthetic_train_n=8192)
     synthetic = data["synthetic"]
     target = float(os.environ.get("GEOMX_BENCH_TTA_TARGET",
-                                  "0.70" if synthetic else "0.92"))
+                                  "0.90" if synthetic else "0.92"))
     max_epochs = int(os.environ.get("GEOMX_BENCH_TTA_EPOCHS", "40"))
 
     topo = HiPSTopology.from_devices()
@@ -279,14 +353,20 @@ def _time_to_accuracy(batch):
         acc = trainer.evaluate(state, data["test_x"], data["test_y"])
         best = max(best, acc)
         if acc >= target:
-            return {"dataset": "synthetic" if synthetic else "cifar10",
-                    "target": target, "reached": True, "epochs": ep + 1,
-                    "seconds": round(time.perf_counter() - t0, 2),
-                    "test_acc": round(acc, 4)}
-    return {"dataset": "synthetic" if synthetic else "cifar10",
-            "target": target, "reached": False, "epochs": max_epochs,
-            "seconds": round(time.perf_counter() - t0, 2),
-            "test_acc": round(best, 4)}
+            out = {"dataset": "synthetic" if synthetic else "cifar10",
+                   "target": target, "reached": True, "epochs": ep + 1,
+                   "seconds": round(time.perf_counter() - t0, 2),
+                   "test_acc": round(acc, 4)}
+            if fetch_note:
+                out["note"] = fetch_note
+            return out
+    out = {"dataset": "synthetic" if synthetic else "cifar10",
+           "target": target, "reached": False, "epochs": max_epochs,
+           "seconds": round(time.perf_counter() - t0, 2),
+           "test_acc": round(best, 4)}
+    if fetch_note:
+        out["note"] = fetch_note
+    return out
 
 
 def _fit_overhead(batch, iters, bare_sps):
@@ -369,7 +449,10 @@ def child_main():
     except Exception as e:
         _emit({"event": "microbench", "error": repr(e)})
 
-    if os.environ.get("GEOMX_BENCH_TTA") == "1":
+    # time-to-accuracy is the north star — runs by DEFAULT (the r3
+    # artifact lacked it because the driver didn't set the env);
+    # GEOMX_BENCH_TTA=0 opts out
+    if os.environ.get("GEOMX_BENCH_TTA", "1") != "0":
         try:
             _emit({"event": "tta", **_time_to_accuracy(batch)})
         except Exception as e:

@@ -62,6 +62,19 @@ class Compressor(abc.ABC):
         return sum(self.wire_bytes_leaf(l) for l in jax.tree.leaves(grads))
 
 
+def default_on_tpu(env_var: str) -> bool:
+    """Shared policy for TPU-only fast paths: on unless ``env_var`` is set
+    to "0"; off (and deterministic) everywhere else.  Used for the fused
+    Pallas 2-bit kernels and BSC's approximate top-k."""
+    import os
+    if os.environ.get(env_var) == "0":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 class NoCompressor(Compressor):
     """Dense fp32 all-reduce (the reference's default uncompressed path)."""
 

@@ -47,11 +47,20 @@ MOMENTUM = 0.9  # hardcoded in the reference (gc.cc:200)
 class BiSparseCompressor(Compressor):
     name = "bsc"
 
-    def __init__(self, ratio: float = 0.01, approx: bool = False,
+    def __init__(self, ratio: float = 0.01, approx: "bool | None" = None,
                  min_sparse_size: int = 1024):
         if ratio <= 0:
             raise ValueError("threshold must be greater than 0")
         self.ratio = float(ratio)
+        if approx is None:
+            # TPU defaults to the hardware-friendly approximate top-k
+            # (~10x faster than exact lax.top_k at multi-million element
+            # sizes; recall>=0.95, and error feedback re-sends what a
+            # round misses).  CPU keeps exact selection — deterministic
+            # behavioral tests vs the reference recurrences run there.
+            # GEOMX_BSC_APPROX_TOPK=0 forces exact selection anywhere.
+            from geomx_tpu.compression.base import default_on_tpu
+            approx = default_on_tpu("GEOMX_BSC_APPROX_TOPK")
         self.approx = approx
         # tensors smaller than this aren't worth sparsifying: 2*k payload
         # would approach the dense size; send dense fp32 instead

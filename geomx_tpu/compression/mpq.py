@@ -23,9 +23,11 @@ class MPQCompressor(Compressor):
     name = "mpq"
 
     def __init__(self, ratio: float = 0.01, size_lower_bound: int = 200_000,
-                 bf16: bool = False, approx: bool = False):
+                 bf16: bool = False, approx: "bool | None" = None):
         self.size_lower_bound = int(size_lower_bound)
         self.small = FP16Compressor(bf16=bf16)
+        # approx=None inherits BiSparseCompressor's platform default
+        # (approximate top-k on TPU, exact elsewhere)
         self.large = BiSparseCompressor(ratio=ratio, approx=approx)
 
     def _route(self, leaf: jax.Array) -> Compressor:

@@ -1,0 +1,82 @@
+"""Fetch CIFAR-10 (binary version) into the data root.
+
+The bench's north star is time-to-92%-accuracy on REAL CIFAR-10
+(BASELINE.md); the dataset is not redistributable inside the repo, so
+this script provisions it at run time when the environment has network
+egress.  `bench.py` calls `ensure(quiet=True)` before the
+time-to-accuracy run and falls back to the synthetic proxy (recording
+the denial) when the download is impossible.
+
+Usage: python tools/fetch_cifar10.py [dest_root]
+Dest defaults to $GEOMX_DATA_DIR or /root/data; the extracted layout is
+<root>/cifar-10-batches-bin/*.bin, which geomx_tpu.data.load_dataset
+discovers directly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import tarfile
+import tempfile
+import urllib.request
+
+URL = "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+MD5 = "c32a1d4ab5d03f1284b67883e8d87530"
+DIRNAME = "cifar-10-batches-bin"
+
+
+def present(root: str) -> bool:
+    d = os.path.join(root, DIRNAME)
+    need = [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]
+    return all(os.path.exists(os.path.join(d, f)) for f in need)
+
+
+def ensure(root: str | None = None, quiet: bool = False,
+           timeout: float = 300.0) -> bool:
+    """Returns True iff the dataset is present (possibly after download)."""
+    root = root or os.environ.get("GEOMX_DATA_DIR", "/root/data")
+    if present(root):
+        return True
+    path = None
+    try:
+        os.makedirs(root, exist_ok=True)
+        if not quiet:
+            print(f"downloading {URL} -> {root}", flush=True)
+        req = urllib.request.Request(URL, headers={"User-Agent": "geomx"})
+        with urllib.request.urlopen(req, timeout=timeout) as r, \
+                tempfile.NamedTemporaryFile(dir=root, suffix=".tar.gz",
+                                            delete=False) as tmp:
+            path = tmp.name
+            h = hashlib.md5()
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+                tmp.write(chunk)
+        if h.hexdigest() != MD5:
+            raise IOError(f"md5 mismatch: {h.hexdigest()} != {MD5}")
+        with tarfile.open(path, "r:gz") as tf:
+            try:
+                tf.extractall(root, filter="data")
+            except TypeError:  # Python < 3.12 without the filter arg
+                tf.extractall(root)
+        return present(root)
+    except Exception as e:
+        if not quiet:
+            print(f"fetch failed: {e!r}", file=sys.stderr, flush=True)
+        return False
+    finally:
+        if path is not None and os.path.exists(path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+if __name__ == "__main__":
+    ok = ensure(sys.argv[1] if len(sys.argv) > 1 else None)
+    print("cifar10 present" if ok else "cifar10 UNAVAILABLE")
+    sys.exit(0 if ok else 1)
