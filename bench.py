@@ -99,6 +99,13 @@ def _build_configs(n_devices: int):
         ("hfa_dgt", {"sync_mode": "hfa", "hfa_k1": 20, "hfa_k2": 10,
                      "enable_dgt": 2, "udp_channel_num": 3, "dgt_k": 0.5,
                      "compression": "none"}, parties),
+        # TPU stem experiment (VERDICT r3 #4): 2x2 space-to-depth stem —
+        # on CIFAR this halves every stage's resolution (a ~4x-fewer-FLOP
+        # sibling of ResNet-20), so compare its samples/sec AND its MFU
+        # against vanilla to see whether the MXU fill or the per-op
+        # overheads dominate at these channel widths
+        ("vanilla_s2d", {"sync_mode": "fsa", "compression": "none",
+                         "model_kwargs": {"space_to_depth": True}}, 1),
     ]
 
 
@@ -117,10 +124,12 @@ def _measure_config(name, overrides, parties, batch, iters, peak):
     parties = min(parties, n_dev)
     workers = max(1, n_dev // parties) if n_dev >= parties else 1
     topo = HiPSTopology(num_parties=parties, workers_per_party=workers)
+    overrides = dict(overrides)
+    model_kwargs = overrides.pop("model_kwargs", {})
     cfg = GeoConfig.from_env(num_parties=parties, workers_per_party=workers,
                              **overrides)
     sync = get_sync_algorithm(cfg)
-    trainer = Trainer(ResNet20(num_classes=10), topo,
+    trainer = Trainer(ResNet20(num_classes=10, **model_kwargs), topo,
                       optax.sgd(0.1, momentum=0.9), sync=sync, config=cfg)
 
     local_b = batch // (parties * workers)
@@ -193,6 +202,103 @@ def _measure_config(name, overrides, parties, batch, iters, peak):
     }
 
 
+def _scan_slope(step, init_carry, lo: int, hi: int, reps: int) -> float:
+    """Per-iteration device seconds for ``step``: the slope of total time
+    vs lax.scan length, min over ``reps``, with the carry value-fetched so
+    completion can't be faked.  The slope cancels the fixed dispatch cost
+    (30-80ms of noisy RTT on a tunneled chip) exactly; ``step`` must
+    thread its inputs through the carry so nothing hoists out of the
+    loop."""
+    import jax
+    import jax.numpy as jnp
+
+    tot = {}
+    for iters in (lo, hi):
+        @jax.jit
+        def run(c, iters=iters):
+            c = jax.lax.scan(lambda cc, _: (step(cc), None), c,
+                             None, length=iters)[0]
+            return jax.tree.map(jnp.sum, c)
+        # compile + one throwaway fetch
+        jax.tree.map(lambda a: float(a), run(init_carry))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.tree.map(lambda a: float(a), run(init_carry))
+            ts.append(time.perf_counter() - t0)
+        tot[iters] = min(ts)
+    return max(0.0, (tot[hi] - tot[lo]) / (hi - lo))
+
+
+def _per_op_profile(batch, peak, on_tpu: bool):
+    """Conv-by-conv roofline table for ResNet-20 (VERDICT r3 #4): each
+    distinct conv shape in the network is slope-timed in isolation
+    (forward, bf16 inputs, fp32 accumulation — the training step's
+    regime; backward convs have the same shapes at ~2x the FLOPs).  The
+    per-shape MXU utilization shows where the step's MFU ceiling comes
+    from: CIFAR channel widths (16/32/64) fill at most 12-50% of a
+    128-wide MXU systolic array by construction."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    B = batch if on_tpu else 64
+    lo, hi, reps = (200, 1000, 5) if on_tpu else (2, 8, 3)
+    # (label, in_hw, cin, cout, k, stride, count_in_resnet20)
+    convs = [
+        ("stem 3x3 3->16 @32", 32, 3, 16, 3, 1, 1),
+        ("stage1 3x3 16->16 @32", 32, 16, 16, 3, 1, 6),
+        ("stage2 3x3 16->32 /2", 32, 16, 32, 3, 2, 1),
+        ("stage2 1x1 16->32 /2", 32, 16, 32, 1, 2, 1),
+        ("stage2 3x3 32->32 @16", 16, 32, 32, 3, 1, 5),
+        ("stage3 3x3 32->64 /2", 16, 32, 64, 3, 2, 1),
+        ("stage3 1x1 32->64 /2", 16, 32, 64, 1, 2, 1),
+        ("stage3 3x3 64->64 @8", 8, 64, 64, 3, 1, 5),
+    ]
+    rows = []
+    total_t = total_f = 0.0
+    for label, hw, cin, cout, k, stride, count in convs:
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(B, hw, hw, cin), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(k, k, cin, cout) * 0.1, jnp.bfloat16)
+
+        def step(c, w=w, stride=stride):
+            y = lax.conv_general_dilated(
+                c, w, (stride, stride), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32)
+            # fold the output into a runtime scalar factor on the input:
+            # the next iteration's conv depends on this one (no hoisting)
+            return c * (1.0 + 1e-9 * jnp.mean(y)).astype(jnp.bfloat16)
+
+        t = _scan_slope(step, x, lo, hi, reps)
+        hout = -(-hw // stride)
+        fl = 2.0 * B * hout * hout * cout * cin * k * k
+        total_t += t * count
+        total_f += fl * count
+        rows.append({
+            "op": label, "count": count, "batch": B,
+            "time_us": round(t * 1e6, 2),
+            "gflops": round(fl / 1e9, 3),
+            "tflops_per_sec": round(fl / t / 1e12, 2) if t > 0 else None,
+            "mxu_util": round(fl / t / peak, 4) if peak and t > 0 else None,
+            # rough fill indicator: output channels over the 128-wide
+            # systolic dimension (XLA's conv lowering can beat it by
+            # packing spatial positions into the contraction)
+            "cout_over_128": round(min(1.0, cout / 128.0), 3),
+        })
+    out = {"note": ("forward convs in isolation; backward shapes "
+                    "identical at ~2x FLOPs.  mxu_util is measured; "
+                    "cout_over_128 is a rough MXU fill indicator for "
+                    "CIFAR channel widths (not a hard bound — XLA packs "
+                    "spatial positions into the contraction)"),
+           "convs": rows}
+    if total_t > 0 and peak:
+        out["weighted_forward_mxu_util"] = round(total_f / total_t / peak, 4)
+    return out
+
+
 def _microbench_kernels(peak, on_tpu: bool):
     """Compression-kernel microbench: Pallas vs jnp 2-bit quantize, exact
     vs approx BSC top-k (VERDICT r1 #7 / r3 #1: prove the Pallas path).
@@ -226,23 +332,7 @@ def _microbench_kernels(peak, on_tpu: bool):
                      "outputs consumed", "elements": n}
 
     def _slope(step, init_carry, lo=lo, hi=hi):
-        """Per-iteration seconds: slope of total time vs scan length."""
-        tot = {}
-        for iters in (lo, hi):
-            @jax.jit
-            def run(c, iters=iters):
-                c = jax.lax.scan(lambda cc, _: (step(cc), None), c,
-                                 None, length=iters)[0]
-                return jax.tree.map(jnp.sum, c)
-            # compile + one throwaway fetch
-            jax.tree.map(lambda a: float(a), run(init_carry))
-            ts = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                jax.tree.map(lambda a: float(a), run(init_carry))
-                ts.append(time.perf_counter() - t0)
-            tot[iters] = min(ts)
-        return max(0.0, (tot[hi] - tot[lo]) / (hi - lo))
+        return _scan_slope(step, init_carry, lo, hi, reps)
 
     from geomx_tpu.compression.twobit import TwoBitCompressor
     jnp_q = TwoBitCompressor(0.5, use_pallas=False).quantize
@@ -423,9 +513,12 @@ def child_main():
            "device_kind": kind, "n_devices": len(devs),
            "peak_bf16_flops": peak})
 
+    # 100 iters on the chip: the tail block_until_ready pays one tunnel
+    # RTT (30-80ms), which at 30 iters inflated every step by 1-2.7ms
+    # and made config-to-config comparisons noise-dominated
     batch = int(os.environ.get("GEOMX_BENCH_BATCH",
                                2048 if on_tpu else 256))
-    iters = int(os.environ.get("GEOMX_BENCH_ITERS", 30 if on_tpu else 5))
+    iters = int(os.environ.get("GEOMX_BENCH_ITERS", 100 if on_tpu else 5))
 
     bare_sps = None
     for name, overrides, parties in _build_configs(len(devs)):
@@ -448,6 +541,11 @@ def child_main():
                **_microbench_kernels(peak, on_tpu)})
     except Exception as e:
         _emit({"event": "microbench", "error": repr(e)})
+
+    try:
+        _emit({"event": "profile", **_per_op_profile(batch, peak, on_tpu)})
+    except Exception as e:
+        _emit({"event": "profile", "error": repr(e)})
 
     # time-to-accuracy is the north star — runs by DEFAULT (the r3
     # artifact lacked it because the driver didn't set the env);
@@ -527,6 +625,8 @@ def _run_attempt(init_timeout, total_timeout, results):
             results["fit_loop"] = ev
         elif kind == "microbench":
             results["microbench"] = ev
+        elif kind == "profile":
+            results["profile"] = ev
         elif kind == "tta":
             results["tta"] = ev
         elif kind == "done":
@@ -547,7 +647,7 @@ def parent_main():
     attempts = int(os.environ.get("GEOMX_BENCH_INIT_ATTEMPTS", "3"))
 
     results = {"configs": {}, "backend": None, "fit_loop": None,
-               "microbench": None, "tta": None}
+               "microbench": None, "profile": None, "tta": None}
     attempt_log = []
     error = None
     for i in range(max(1, attempts)):
@@ -580,6 +680,7 @@ def parent_main():
         "configs": configs,
         "fit_loop": results["fit_loop"],
         "microbench": microbench,
+        "profile": results["profile"],
     }
     if tta is not None:
         out["time_to_accuracy"] = tta

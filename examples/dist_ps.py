@@ -101,6 +101,9 @@ def run_global_server():
         # GLOBAL_HOST (this process runs on that host)
         sc.register("global_server", host=GLOBAL_HOST, port=port,
                     tag=str(GS_ID))
+        # keep the scheduler's liveness view fed for the process lifetime
+        # (reference Van::Heartbeat timer)
+        sc.start_heartbeat()
     print(f"[global_server {GS_ID}] listening on {port} "
           f"({NUM_PARTIES} parties, {MODE})", flush=True)
     srv.join()
@@ -114,6 +117,7 @@ def run_global_server():
 def run_local_server():
     from geomx_tpu.service import GeoPSServer
     port = LOCAL_PORT + PARTY_ID
+    sc = None
     if USE_SCHEDULER:
         # discover the global tier from the roster (sorted by node id, so
         # every party sees the same shard order)
@@ -122,9 +126,9 @@ def run_local_server():
         # per party for multi-host runs) — the address workers dial
         sc.register("server", host=LOCAL_HOST, port=port,
                     tag=str(PARTY_ID))
+        sc.start_heartbeat()
         gaddrs = [(h, p) for (_id, h, p, _t) in
                   sc.wait_for("global_server", NUM_GLOBAL_SERVERS)]
-        sc.close()
     else:
         gaddrs = [(GLOBAL_HOST, GLOBAL_PORT + i)
                   for i in range(NUM_GLOBAL_SERVERS)]
@@ -138,6 +142,8 @@ def run_local_server():
           f"({WORKERS_PER_PARTY} workers, compression={COMPRESSION})",
           flush=True)
     srv.join()
+    if sc is not None:
+        sc.close()
     print(f"[server p{PARTY_ID}] stopped", flush=True)
 
 
@@ -163,11 +169,14 @@ def run_worker():
 
     from geomx_tpu.service import GeoPSClient
 
+    sc = None
     if USE_SCHEDULER:
         # find THIS party's server through the roster instead of env math
         sc = _sched_client()
+        sc.register("worker", host=LOCAL_HOST, port=0,
+                    tag=f"{PARTY_ID}.{WORKER_ID}")
+        sc.start_heartbeat()
         entry = sc.wait_for("server", 1, tag=str(PARTY_ID))[0]
-        sc.close()
         server_addr = (entry[1], entry[2])
     else:
         server_addr = (LOCAL_HOST, LOCAL_PORT + PARTY_ID)
@@ -291,6 +300,8 @@ def run_worker():
     # kvstore_dist_server.h:289-301 counts stop commands per tier)
     c.stop_server()
     c.close()
+    if sc is not None:
+        sc.close()
 
 
 if __name__ == "__main__":

@@ -47,8 +47,10 @@ class GeoScheduler:
     def __init__(self, port: int = 0, bind_host: Optional[str] = None,
                  heartbeat_timeout: float = 15.0):
         self._lock = threading.Lock()
-        # (role, host, port) -> assigned id; survives re-registration
-        self._assigned: Dict[Tuple[str, str, int], int] = {}
+        # (role, host, port, tag) -> assigned id; survives re-registration
+        # (tag disambiguates nodes with no serving port, e.g. workers
+        # registering with port 0 and tag "<party>.<rank>")
+        self._assigned: Dict[Tuple[str, str, int, str], int] = {}
         self._roster: Dict[str, list] = {}   # role -> [(id, host, port)]
         self._next = {"server": KOFFSET, "worker": KOFFSET + 1,
                       "global_server": 8, "global_worker": 9}
@@ -138,7 +140,7 @@ class GeoScheduler:
             tag = str(msg.meta.get("tag", ""))
             prev = msg.meta.get("prev_id")
             with self._lock:
-                key = (role, host, port)
+                key = (role, host, port, tag)
                 node_id = self._assigned.get(key)
                 if node_id is None and prev is not None:
                     # explicit recovery claim (e.g. restarted on a new
@@ -157,7 +159,7 @@ class GeoScheduler:
                 if node_id is None:
                     node_id = self._next[role]
                     self._next[role] += 2   # keep parity per role
-                self._assigned[(role, host, port)] = node_id
+                self._assigned[key] = node_id
                 entries = [e for e in self._roster.setdefault(role, [])
                            if e[0] != node_id]
                 entries.append((node_id, host, port, tag))
@@ -202,10 +204,13 @@ class SchedulerClient:
     """A node's line to the scheduler: register, discover, barrier."""
 
     def __init__(self, addr: Tuple[str, int]):
+        self._addr = addr
         self._sock = connect_retry(addr)
         self._lock = threading.Lock()
         self.node_id: Optional[int] = None
         self.is_recovery = False
+        self._hb_stop: Optional[threading.Event] = None
+        self._hb_sock: Optional[socket.socket] = None
 
     def _rpc(self, msg: Msg) -> Msg:
         with self._lock:
@@ -264,6 +269,69 @@ class SchedulerClient:
         msg.sender = self.node_id if self.node_id is not None else -1
         self._rpc(msg)
 
+    def start_heartbeat(self, interval_s: Optional[float] = None
+                        ) -> "SchedulerClient":
+        """Run the node->scheduler heartbeat loop on a daemon thread (the
+        reference Van::Heartbeat timer, van.cc:1147-1160) so the
+        scheduler's cluster-wide dead-node detection sees this node live.
+        Call after register(); close() stops it.  Interval defaults to
+        GEOMX_HEARTBEAT_INTERVAL (PS_HEARTBEAT_INTERVAL alias) seconds."""
+        if interval_s is None:
+            interval_s = float(
+                os.environ.get("GEOMX_HEARTBEAT_INTERVAL")
+                or os.environ.get("PS_HEARTBEAT_INTERVAL") or "3")
+        if self._hb_stop is not None:
+            return self
+        stop = self._hb_stop = threading.Event()
+        node_id = self.node_id if self.node_id is not None else -1
+
+        def run():
+            # DEDICATED connection: the main socket's lock is held for the
+            # whole of a blocking barrier() wait, which would starve the
+            # heartbeat and get a live waiting node declared dead
+            sock = None
+            failures = 0
+            while not stop.wait(interval_s):
+                try:
+                    if sock is None:
+                        sock = connect_retry(self._addr,
+                                             total_timeout_s=5.0)
+                        sock.settimeout(10.0)
+                        self._hb_sock = sock
+                    msg = Msg(MsgType.HEARTBEAT)
+                    msg.sender = node_id
+                    send_frame(sock, msg)
+                    if recv_frame(sock) is None:
+                        raise ConnectionError("scheduler closed")
+                    failures = 0
+                except (OSError, ConnectionError, ValueError,
+                        pickle.UnpicklingError):
+                    # transient: reconnect next tick; give up only after
+                    # sustained failure (scheduler genuinely gone)
+                    try:
+                        if sock is not None:
+                            sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+                    failures += 1
+                    if failures > 10:
+                        return
+            try:
+                if sock is not None:
+                    sock.close()
+            except OSError:
+                pass
+        threading.Thread(target=run, daemon=True,
+                         name=f"sched-heartbeat-{node_id}").start()
+        return self
+
+    def dead_nodes(self, timeout: Optional[float] = None) -> list:
+        """The scheduler's cluster-wide dead list (reference
+        Postoffice::GetDeadNodes surfaced via the scheduler role)."""
+        return list(self._rpc(Msg(MsgType.COMMAND, meta={
+            "cmd": "num_dead_nodes", "timeout": timeout})).meta["dead"])
+
     def stop_scheduler(self) -> None:
         try:
             self._rpc(Msg(MsgType.STOP))
@@ -271,6 +339,13 @@ class SchedulerClient:
             pass
 
     def close(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        if self._hb_sock is not None:
+            try:
+                self._hb_sock.close()
+            except OSError:
+                pass
         try:
             self._sock.close()
         except OSError:

@@ -196,6 +196,14 @@ class GeoPSServer:
         if inter_ts is None:
             inter_ts = bool(env_int(("GEOMX_ENABLE_INTER_TS",
                                      "ENABLE_INTER_TS"), 0))
+        if inter_ts and compression is not None:
+            import warnings
+            warnings.warn(
+                "ENABLE_INTER_TS requires an uncompressed global link "
+                "(relay merges are additive sums); running the PLAIN "
+                "direct topology instead. Drop the compression spec to "
+                "get the inter-party relay overlay.", RuntimeWarning,
+                stacklevel=2)
         self.inter_ts = inter_ts and compression is None
         # DGT on the WAN hop (the reference's DataPushToGlobalServers ->
         # DGT_Send path): uncompressed dense relays go through the global
@@ -233,6 +241,16 @@ class GeoPSServer:
         if self._global_addrs:
             from geomx_tpu.service.client import GeoPSClient
             ts = self.inter_ts and len(self._global_addrs) == 1
+            if self.inter_ts and not ts:
+                import warnings
+                warnings.warn(
+                    "ENABLE_INTER_TS does not compose with MultiGPS "
+                    f"({len(self._global_addrs)} global servers): the "
+                    "ASK1 overlay aggregates whole tensors, which "
+                    "conflicts with sharded global placement; running "
+                    "the PLAIN direct topology instead. Use a single "
+                    "global server for the inter-party relay overlay.",
+                    RuntimeWarning, stacklevel=2)
             self._gclients = [
                 GeoPSClient(addr, sender_id=self._global_sender_id,
                             ts_node=self._global_ts_node if ts else None)

@@ -138,3 +138,46 @@ def test_discovery_end_to_end_training():
     g.close()
     for sc in regs:
         sc.close()
+
+
+def test_scheduler_dead_node_detection_and_rejoin_clears():
+    """VERDICT r3 #6: nodes run a periodic heartbeat loop to the
+    scheduler; when one dies the SCHEDULER's dead list reports it, and a
+    replacement re-registering under the same identity (same role/tag)
+    reclaims the node id and clears the dead report."""
+    import time
+
+    sched = GeoScheduler(port=0, heartbeat_timeout=0.8).start()
+    addr = ("127.0.0.1", sched.port)
+    a = SchedulerClient(addr)
+    a.register("worker", port=0, tag="0.0")
+    a.start_heartbeat(interval_s=0.1)
+    b = SchedulerClient(addr)
+    b.register("worker", port=0, tag="0.1")
+    b.start_heartbeat(interval_s=0.1)
+    bid = b.node_id
+
+    time.sleep(1.2)  # longer than the timeout: heartbeats keep both live
+    assert a.dead_nodes() == []
+
+    b.close()  # "kill" worker b: its heartbeat loop stops
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if bid in a.dead_nodes():
+            break
+        time.sleep(0.1)
+    assert bid in a.dead_nodes(), "scheduler never noticed the dead worker"
+    assert a.node_id not in a.dead_nodes()
+
+    # replacement rejoins under the same identity: same id, recovery
+    # flagged, and the fresh heartbeats clear the dead report
+    b2 = SchedulerClient(addr)
+    meta = b2.register("worker", port=0, tag="0.1")
+    assert meta["node_id"] == bid and meta["is_recovery"]
+    b2.start_heartbeat(interval_s=0.1)
+    time.sleep(0.3)
+    assert bid not in a.dead_nodes()
+
+    for c in (a, b2):
+        c.close()
+    sched.stop()
