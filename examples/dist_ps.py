@@ -90,8 +90,14 @@ def run_global_server():
     # HFA: the global store accumulates parties' milestone deltas onto the
     # initial params, so it always holds the authoritative model
     port = GLOBAL_PORT + GS_ID
+    # ENABLE_INTER_TS: the global tier also disseminates fresh params
+    # down to the local servers (AutoPull with the global server as node
+    # 0) — requires the auto_pull distributor, single-global only
+    inter_ts = bool(env("GEOMX_ENABLE_INTER_TS", 0, int)
+                    or env("ENABLE_INTER_TS", 0, int))
     srv = GeoPSServer(port=port, num_workers=NUM_PARTIES,
                       mode=MODE, rank=GS_ID,
+                      auto_pull=inter_ts and NUM_GLOBAL_SERVERS == 1,
                       accumulate=(SYNC == "hfa")).start()
     sc = None
     if USE_SCHEDULER:

@@ -43,13 +43,14 @@ class _RelayConnectError(OSError):
 
 
 class _Pending:
-    __slots__ = ("event", "reply", "frame", "priority")
+    __slots__ = ("event", "reply", "frame", "priority", "parts")
 
     def __init__(self):
         self.event = threading.Event()
         self.reply: Optional[Msg] = None
         self.frame: Optional[bytes] = None   # kept for resend
         self.priority: int = 0
+        self.parts: Optional[dict] = None    # chunked PULL_REPLY assembly
 
 
 class GeoPSClient:
@@ -96,6 +97,10 @@ class GeoPSClient:
             from geomx_tpu.transport import P3Slicer
             self._slicer = P3Slicer(p3_slice_elems)
         self._multi: Dict[int, list] = {}   # meta-rid -> per-chunk rids
+        # test/observability hook: when set to a list, PULL replies are
+        # logged as (key, chunk_index|None) in arrival order — the pull
+        # mirror of the server's push_log
+        self.reply_log: Optional[list] = None
         # per-key push round ids: lets the server dedup a restarted
         # worker's replayed push exactly (see recover())
         self._key_rounds: Dict[str, int] = {}
@@ -237,8 +242,37 @@ class GeoPSClient:
             with self._plock:
                 p = self._pending.get(rid)
             if p is not None:
+                if msg.type == MsgType.PULL_REPLY and \
+                        msg.meta.get("chunk") is not None:
+                    # P3 pull chunk: assemble; the reply completes when
+                    # the set does (reference P3_ZPull reassembly)
+                    if self.reply_log is not None:
+                        self.reply_log.append((msg.key,
+                                               int(msg.meta["chunk"])))
+                    msg = self._pull_chunk(p, msg)
+                    if msg is None:
+                        continue
+                elif self.reply_log is not None and \
+                        msg.type == MsgType.PULL_REPLY:
+                    self.reply_log.append((msg.key, None))
                 p.reply = msg
                 p.event.set()
+
+    def _pull_chunk(self, p: _Pending, msg: Msg) -> Optional[Msg]:
+        """Fold one PULL_REPLY chunk into the pending entry; returns the
+        assembled whole-tensor reply when complete, else None.  The
+        shared ChunkAssembler keys the assembly on the server-side
+        generation id, so a retransmit-triggered second reply (re-sliced
+        from a NEWER value) resets the set instead of blending."""
+        if p.parts is None:
+            from geomx_tpu.transport import ChunkAssembler
+            p.parts = ChunkAssembler()
+        out = p.parts.feed(msg.meta, msg.array)
+        if out is None:
+            return None
+        p.parts = None
+        return Msg(MsgType.PULL_REPLY, key=msg.key,
+                   meta={"rid": msg.meta.get("rid")}, array=out)
 
     def _submit(self, msg: Msg, priority: int = 0) -> int:
         """Enqueue a request; returns its timestamp (request id)."""
@@ -270,6 +304,16 @@ class GeoPSClient:
 
     def resume_sending(self) -> None:
         self._send_gate.set()
+
+    def pause_pull_stream(self) -> None:
+        """Hold the server's chunked-reply drain for THIS connection:
+        queued pull-reply chunks accumulate server-side and leave in
+        priority order on resume (test hook, mirror of pause_sending)."""
+        self._request(Msg(MsgType.COMMAND, meta={"cmd": "pause_pull_stream"}))
+
+    def resume_pull_stream(self) -> None:
+        self._request(Msg(MsgType.COMMAND,
+                          meta={"cmd": "resume_pull_stream"}))
 
     def wait(self, rid: int, timeout: Optional[float] = None) -> Msg:
         """Block until request `rid` completes (reference Customer::Wait).
@@ -454,7 +498,15 @@ class GeoPSClient:
 
     def pull_async(self, key: str, priority: int = 0,
                    meta: Optional[dict] = None) -> int:
-        return self._submit(Msg(MsgType.PULL, key=key, meta=dict(meta or {})),
+        m = dict(meta or {})
+        if self._slicer is not None:
+            # P3 pull-side chunking: ask the server to slice a big reply
+            # into priority-tagged chunks through its send queue, so a
+            # front layer's weights can overtake a queued back-layer
+            # reply (reference P3_ZPull, kv_app.h:246-306)
+            m.setdefault("p3_chunk_elems", self.p3_slice_elems)
+            m.setdefault("priority", priority)
+        return self._submit(Msg(MsgType.PULL, key=key, meta=m),
                             priority=priority)
 
     def auto_pull(self, key: str, min_version: int = 0,

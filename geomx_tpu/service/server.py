@@ -21,6 +21,7 @@ wire buffers, decompressed here (server-side BSCDecompress).
 
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
 import queue
@@ -43,7 +44,7 @@ class _KeyState:
         self.count = 0
         self.round = 0            # completed merge rounds
         self.pushed: Dict[int, int] = {}   # sender -> rounds pushed
-        self.waiting_pulls = []   # (conn, rid, round_needed) until merged
+        self.waiting_pulls = []   # (conn, request Msg, round_needed)
         # HFA: last globally-agreed value (the reference's stored_milestone,
         # kvstore_dist_server.h:988-1017)
         self.milestone: Optional[np.ndarray] = None
@@ -163,6 +164,14 @@ class GeoPSServer:
         # _relay_loop.  Lazily spawned; guarded by self._lock.
         self._relay_shards = 8
         self._relay_qs: Dict[int, "queue.Queue"] = {}
+        # P3 pull-side chunking (reference P3_ZPull, kv_app.h:246-306):
+        # big PULL replies leave through a per-connection PRIORITY queue
+        # as chunk messages, so a front-layer reply overtakes a queued
+        # back-layer reply on the return path.  Gates are test hooks
+        # (pause_pull_stream command) making the reorder deterministic.
+        self._out_qs: Dict[int, Any] = {}
+        self._out_gates: Dict[int, threading.Event] = {}
+        self._pull_gen = itertools.count(1)
         # remotely-controllable profiler (reference kSetProfilerParams,
         # kvstore_dist_server.h:383-430)
         from geomx_tpu.utils.profiler import Profiler
@@ -238,6 +247,7 @@ class GeoPSServer:
     # ---- lifecycle ---------------------------------------------------------
 
     def start(self):
+        self._g_autopull = False
         if self._global_addrs:
             from geomx_tpu.service.client import GeoPSClient
             ts = self.inter_ts and len(self._global_addrs) == 1
@@ -260,6 +270,20 @@ class GeoPSServer:
                 # round ids where its dead incarnation left off, or the
                 # round-dedup would absorb all its future relays
                 c.recover()
+            if ts:
+                # inter-party pull-side dissemination (the reference's
+                # global AutoPull, kv_app.h:586-691): register for
+                # server-initiated updates so fresh params come DOWN in
+                # the global tier's throughput-scheduled order instead of
+                # per-party min_round-gated pulls.  A global tier started
+                # without auto_pull declines; we fall back to gated pulls.
+                try:
+                    self._gclients[0]._request(Msg(
+                        MsgType.COMMAND,
+                        meta={"cmd": "register_autopull"}))
+                    self._g_autopull = True
+                except (RuntimeError, ConnectionError, TimeoutError):
+                    self._g_autopull = False
         self._accept_thread.start()
         if self.ts_sched is not None:
             self._ap_thread = threading.Thread(target=self._autopull_loop,
@@ -322,6 +346,13 @@ class GeoPSServer:
         try:
             self._serve_conn_loop(conn)
         finally:
+            q = self._out_qs.pop(id(conn), None)
+            if q is not None:
+                q.close()  # wakes a drain thread blocked in pop()
+            gate = self._out_gates.pop(id(conn), None)
+            if gate is not None:
+                gate.set()  # ...and one parked in a paused gate.wait()
+                # (its sendall then fails on the dead socket and it exits)
             self._conn_wlocks.pop(id(conn), None)  # don't leak per-conn locks
             self._conns.discard(conn)
 
@@ -565,6 +596,18 @@ class GeoPSServer:
                 meta={"dead": self.heartbeats.dead_nodes(
                     msg.meta.get("timeout"))}))
             return
+        elif cmd == "pause_pull_stream":
+            # test/demo hook (mirror of the client's pause_sending): hold
+            # this connection's chunked-reply drain so queued replies
+            # re-order by priority observably
+            gate = self._out_gates.get(id(conn))
+            if gate is None:
+                gate = self._out_gates[id(conn)] = threading.Event()
+            gate.clear()
+        elif cmd == "resume_pull_stream":
+            gate = self._out_gates.get(id(conn))
+            if gate is not None:
+                gate.set()
         else:
             self._reply(conn, msg, Msg(MsgType.ERROR,
                                        meta={"error": f"bad cmd {cmd}"}))
@@ -733,11 +776,17 @@ class GeoPSServer:
         if c0.ts_node is not None:
             # inter-party TS: announce the partial to the global ASK1
             # scheduler (it may relay-merge through a faster party before
-            # hitting the sink) and gate the pull on the round we joined
+            # hitting the sink); the fresh value comes back via the
+            # global tier's AutoPull dissemination (throughput-scheduled
+            # server-initiated push-down, kv_app.h:586-691) when the
+            # tier supports it, else a min_round-gated pull
             rnd = self._ground[key] = self._ground.get(key, 0) + 1
             c0.ts_push(key, np.asarray(grad, np.float32))
-            pulled = c0.pull(key, timeout=120.0,
-                             meta={"min_round": rnd, "reliable": True})
+            if self._g_autopull:
+                pulled = c0.auto_pull(key, min_version=rnd, timeout=120.0)
+            else:
+                pulled = c0.pull(key, timeout=120.0,
+                                 meta={"min_round": rnd, "reliable": True})
             return np.asarray(pulled, np.float32).reshape(grad.shape)
         meta = {}
         payload = grad
@@ -940,24 +989,16 @@ class GeoPSServer:
         """Collect one P3 chunk; returns the reassembled tensor when the
         set completes, else None.  Caller holds self._lock.  Keyed by
         (sender, key): one chunked push per key per sender may be in
-        flight, which the per-round push discipline guarantees."""
+        flight, which the per-round push discipline guarantees.  The
+        buffer is kept until the caller pops it post-merge, so a
+        retransmitted final chunk can retry after a failure."""
+        from geomx_tpu.transport import ChunkAssembler
         pk = (msg.sender, msg.key)
         part = self._p3_partial.get(pk)
-        n_total = int(msg.meta["n_total"])
-        num = int(msg.meta["num_chunks"])
-        if part is None or part["n_total"] != n_total \
-                or part["num"] != num:
-            part = {"buf": np.zeros((n_total,), np.float32), "got": set(),
-                    "num": num, "n_total": n_total,
-                    "shape": tuple(msg.meta["shape"])}
-            self._p3_partial[pk] = part
-        start = int(msg.meta["start"])
-        flat = np.asarray(piece, np.float32).reshape(-1)
-        part["buf"][start:start + flat.size] = flat
-        part["got"].add(int(msg.meta["chunk"]))
-        if len(part["got"]) < part["num"]:
-            return None
-        return part["buf"].reshape(part["shape"])
+        if part is None:
+            part = self._p3_partial[pk] = \
+                ChunkAssembler(clear_on_complete=False)
+        return part.feed(msg.meta, piece)
 
     @staticmethod
     def _rs_unique(rows_list, vals_list):
@@ -1096,20 +1137,18 @@ class GeoPSServer:
         it unblocks, feed the TS distributor.  Caller holds self._lock."""
         st.round += 1
         still = []
-        for c, rid, need, rows in st.waiting_pulls:
+        for c, req, need in st.waiting_pulls:
             if st.round >= need:
+                rows = req.meta.get("rows")
                 val = st.value if rows is None else \
                     st.value[np.asarray(rows, np.int64)]
-                reply = Msg(MsgType.PULL_REPLY, key=key, array=val)
-                if rid is not None:
-                    reply.meta["rid"] = rid
                 try:
-                    self._send_msg(c, reply)
+                    self._reply_pull_value(c, req, key, val)
                 except OSError:
                     pass  # dead waiter (crashed worker): drop its entry —
                     # the round must still complete for the live ones
             else:
-                still.append((c, rid, need, rows))
+                still.append((c, req, need))
         st.waiting_pulls = still
         if self.ts_sched is not None:
             # hand an immutable snapshot to the distributor thread:
@@ -1185,9 +1224,10 @@ class GeoPSServer:
                         continue
                     st.relay_error = f"global relay failed: {e!r}"
                     waiters, st.waiting_pulls = st.waiting_pulls, []
-                for c, rid, _need, _rows in waiters:
+                for c, req, _need in waiters:
                     err = Msg(MsgType.ERROR,
                               meta={"error": st.relay_error})
+                    rid = req.meta.get("rid")
                     if rid is not None:
                         err.meta["rid"] = rid
                     try:
@@ -1290,13 +1330,91 @@ class GeoPSServer:
                 # twice — the original entry will answer it; different
                 # connections may legitimately collide on rid
                 if rid is None or all(
-                        not (w[0] is conn and w[1] == rid)
+                        not (w[0] is conn and w[1].meta.get("rid") == rid)
                         for w in st.waiting_pulls):
-                    st.waiting_pulls.append((conn, rid, need,
-                                             msg.meta.get("rows")))
+                    st.waiting_pulls.append((conn, msg, need))
                 return
             rows = msg.meta.get("rows")
             val = st.value if rows is None else \
                 st.value[np.asarray(rows, np.int64)]
-            self._reply(conn, msg, Msg(MsgType.PULL_REPLY, key=msg.key,
-                                       array=val))
+            self._reply_pull_value(conn, msg, msg.key, val)
+
+    def _reply_pull_value(self, conn, req: Msg, key: str, val):
+        """Answer a PULL: whole tensor directly, or — when the request
+        opted into P3 pull chunking and the tensor is big — as
+        priority-tagged chunks through the connection's priority send
+        queue (reference P3_ZPull slicing the reply the same way the
+        push side slices, kv_app.h:246-306)."""
+        ce = req.meta.get("p3_chunk_elems")
+        if not ce or val.size <= int(ce):
+            reply = Msg(MsgType.PULL_REPLY, key=key, array=val)
+            self._reply(conn, req, reply)
+            return
+        ce = int(ce)
+        flat = np.asarray(val, np.float32).reshape(-1)
+        n = int(flat.size)
+        num = -(-n // ce)
+        prio = int(req.meta.get("priority", 0))
+        rid = req.meta.get("rid")
+        # one generation id per reply: a retransmitted PULL re-sliced
+        # from a newer value must not blend with the first reply's
+        # chunks in the client's assembler
+        gen = next(self._pull_gen)
+        q = self._conn_out_q(conn)
+        for i in range(num):
+            rep = Msg(MsgType.PULL_REPLY, key=key,
+                      meta={"chunk": i, "num_chunks": num, "start": i * ce,
+                            "n_total": n, "shape": list(val.shape),
+                            "gen": gen},
+                      array=flat[i * ce:(i + 1) * ce])
+            if rid is not None:
+                rep.meta["rid"] = rid
+            try:
+                q.push(rep.encode(), prio)
+            except RuntimeError as e:
+                # queue closed under us (connection torn down): surface
+                # as the connection error it is, which every reply site
+                # already tolerates
+                raise OSError(f"connection closed: {e}") from e
+
+    def _conn_out_q(self, conn):
+        """Lazily create the per-connection priority send queue + drain
+        thread (the server half of the P3 send discipline: queued chunk
+        replies leave in priority order, not submission order)."""
+        qid = id(conn)
+        q = self._out_qs.get(qid)
+        if q is None:
+            if conn not in self._conns:
+                # the waiter is gone (its serve thread already cleaned
+                # up); creating a queue now would leave a stale entry
+                # that an id()-reusing NEW connection could inherit
+                raise OSError("connection closed")
+            from geomx_tpu.transport import PrioritySendQueue
+            q = self._out_qs[qid] = PrioritySendQueue()
+            gate = self._out_gates.get(qid)
+            if gate is None:  # don't undo a pause_pull_stream that
+                gate = self._out_gates[qid] = threading.Event()  # came first
+                gate.set()
+
+            def drain():
+                while True:
+                    frame = q.pop()
+                    if frame is None:
+                        return
+                    gate.wait()
+                    lock = self._conn_wlocks.setdefault(
+                        qid, threading.Lock())
+                    with lock:
+                        try:
+                            conn.sendall(
+                                len(frame).to_bytes(4, "little") + frame)
+                        except OSError:
+                            # dead socket: drop our queue entry (only if
+                            # it is still ours — the serve thread may
+                            # have cleaned up and a new conn reused qid)
+                            if self._out_qs.get(qid) is q:
+                                self._out_qs.pop(qid, None)
+                            q.close()
+                            return
+            threading.Thread(target=drain, daemon=True).start()
+        return q

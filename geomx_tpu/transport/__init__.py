@@ -8,7 +8,9 @@ latency-hiding scheduler overlaps collectives with compute — but their
 and is implemented here as standalone, fully-tested components.
 """
 
-from geomx_tpu.transport.p3 import P3Slicer, PrioritySendQueue
+from geomx_tpu.transport.p3 import (ChunkAssembler, P3Slicer,
+                                    PrioritySendQueue)
 from geomx_tpu.transport.tsengine import TSEngineScheduler
 
-__all__ = ["P3Slicer", "PrioritySendQueue", "TSEngineScheduler"]
+__all__ = ["ChunkAssembler", "P3Slicer", "PrioritySendQueue",
+           "TSEngineScheduler"]

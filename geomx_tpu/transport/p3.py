@@ -72,6 +72,47 @@ class P3Slicer:
         return out
 
 
+class ChunkAssembler:
+    """Reassemble a chunked tensor stream — the receive half of P3,
+    shared by the server's push reassembly and the client's pull-reply
+    reassembly so the chunk wire protocol has one source of truth.
+
+    ``feed(meta, piece)`` folds one chunk in and returns the completed
+    tensor (reshaped) when the set completes, else None.  The assembly
+    signature is (n_total, num_chunks, gen): a sender that re-slices a
+    NEWER value (e.g. a retransmit-triggered second reply) bumps ``gen``,
+    which resets the assembly — stale and fresh chunks must never blend
+    into a torn tensor.
+
+    ``clear_on_complete=False`` keeps the buffer after completion (the
+    server's merge path clears explicitly only once the merge really
+    happened, so a retransmitted final chunk can retry after a failure).
+    """
+
+    def __init__(self, clear_on_complete: bool = True):
+        self.clear_on_complete = clear_on_complete
+        self._st: Optional[dict] = None
+
+    def feed(self, meta: dict, piece: np.ndarray):
+        n = int(meta["n_total"])
+        num = int(meta["num_chunks"])
+        sig = (n, num, meta.get("gen"))
+        if self._st is None or self._st["sig"] != sig:
+            self._st = {"sig": sig, "buf": np.zeros((n,), np.float32),
+                        "got": set(), "shape": tuple(meta["shape"])}
+        st = self._st
+        flat = np.asarray(piece, np.float32).reshape(-1)
+        start = int(meta["start"])
+        st["buf"][start:start + flat.size] = flat
+        st["got"].add(int(meta["chunk"]))
+        if len(st["got"]) < num:
+            return None
+        out = st["buf"].reshape(st["shape"])
+        if self.clear_on_complete:
+            self._st = None
+        return out
+
+
 class PrioritySendQueue:
     """Thread-safe max-priority queue with FIFO tie-breaking.
 

@@ -103,3 +103,84 @@ def test_multi_worker_chunked_sync_merge():
     for c in cs:
         c.stop_server()
         c.close()
+
+
+def test_chunked_pull_roundtrip():
+    """A big pull comes back as priority-tagged chunks and reassembles
+    exactly (reference P3_ZPull, kv_app.h:246-306)."""
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0,
+                    p3_slice_elems=1000)
+    c.reply_log = []
+    n = 4096
+    v = np.random.RandomState(0).randn(n).astype(np.float32)
+    c.init("w", v)
+    out = c.pull("w")
+    assert np.array_equal(out, v)
+    chunks = [e for e in c.reply_log if e[0] == "w" and e[1] is not None]
+    assert len(chunks) == 5  # 4096 at slice 1000 -> 5 chunks
+    c.stop_server()
+    c.close()
+
+
+def test_pull_reply_chunks_interleave_on_the_return_path():
+    """The pull mirror of the P3 claim: with the server's reply drain
+    held, a later-requested high-priority front-layer pull's chunks
+    overtake the queued chunks of an earlier low-priority back-layer
+    pull on the return path.  (The drain may already hold one popped
+    frame when the gate closes, so at most the first back chunk
+    escapes.)"""
+    import time
+
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0,
+                    p3_slice_elems=500)
+    c.reply_log = []
+    back = np.full(2000, 1.0, np.float32)    # 4 chunks, priority 0
+    front = np.full(1000, 2.0, np.float32)   # 2 chunks, priority 5
+    c.init("back", back)
+    c.init("front", front)
+
+    c.pause_pull_stream()
+    t_back = c.pull_async("back", priority=0)
+    t_front = c.pull_async("front", priority=5)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(server._out_qs) == 0:
+        time.sleep(0.05)  # both replies queued server-side
+    time.sleep(0.2)
+    c.resume_pull_stream()
+    out_back = np.asarray(c.wait(t_back, 30.0).array, np.float32)
+    out_front = np.asarray(c.wait(t_front, 30.0).array, np.float32)
+    np.testing.assert_array_equal(out_back, back)
+    np.testing.assert_array_equal(out_front, front)
+
+    order = [(k, i) for (k, i) in c.reply_log if i is not None]
+    front_pos = [p for p, (k, _) in enumerate(order) if k == "front"]
+    back_pos = [p for p, (k, _) in enumerate(order) if k == "back" and p > 0]
+    assert len(front_pos) == 2 and len(order) == 6, order
+    assert max(front_pos) < min(back_pos), order
+    c.stop_server()
+    c.close()
+
+
+def test_chunked_pull_of_waiting_sync_round():
+    """A chunk-requesting pull that parks on an incomplete sync round is
+    answered in chunks when the round completes (the waiting-pull path
+    goes through the same chunked reply)."""
+    import threading
+
+    server = GeoPSServer(num_workers=2, mode="sync").start()
+    cs = [GeoPSClient(("127.0.0.1", server.port), sender_id=i,
+                      p3_slice_elems=400) for i in range(2)]
+    n = 1500
+    for c in cs:
+        c.init("w", np.zeros(n, np.float32))
+    cs[0].push("w", np.full(n, 1.0, np.float32))
+    t = cs[0].pull_async("w")          # parks: round needs worker 1
+    threading.Timer(0.3, lambda: cs[1].push(
+        "w", np.full(n, 2.0, np.float32))).start()
+    out = np.asarray(cs[0].wait(t, 30.0).array, np.float32)
+    np.testing.assert_allclose(out, 3.0)
+    for c in cs:
+        c.stop_server()
+        c.close()

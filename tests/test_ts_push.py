@@ -220,3 +220,74 @@ def test_inter_ts_degraded_configs_warn():
     s2.stop()
     for g in gs:
         g.stop()
+
+
+def test_inter_ts_pull_side_dissemination(monkeypatch):
+    """VERDICT r3 #8: with ENABLE_INTER_TS and an auto_pull-capable
+    global tier, local servers receive fresh params via the global
+    AutoPull dissemination (server-initiated push-down) instead of
+    min_round-gated pulls — and the trained params match the direct
+    topology exactly."""
+    import threading
+
+    def run(inter: bool, auto_pull: bool):
+        if inter:
+            monkeypatch.setenv("GEOMX_ENABLE_INTER_TS", "1")
+        else:
+            monkeypatch.delenv("GEOMX_ENABLE_INTER_TS", raising=False)
+        gsrv = GeoPSServer(num_workers=2, mode="sync", rank=0,
+                           auto_pull=auto_pull).start()
+        locals_ = [GeoPSServer(num_workers=1, mode="sync",
+                               global_addr=("127.0.0.1", gsrv.port),
+                               global_sender_id=1000 + p, rank=1 + p).start()
+                   for p in range(2)]
+        logs = []
+        for ls in locals_:
+            if ls._gclients:
+                ls._gclients[0].reply_log = log = []
+                logs.append(log)
+        cs = [GeoPSClient(("127.0.0.1", ls.port), sender_id=0)
+              for ls in locals_]
+        n = 80
+        for c in cs:
+            c.init("w", np.zeros(n, np.float32))
+        for c in cs:
+            c.set_optimizer("sgd", learning_rate=0.1)
+
+        rng = np.random.RandomState(3)
+        rounds = [[rng.randn(n).astype(np.float32) for _ in cs]
+                  for _ in range(3)]
+        out = [None, None]
+        for gs in rounds:
+            ts = []
+            for i, (c, g) in enumerate(zip(cs, gs)):
+                def go(i=i, c=c, g=g):
+                    c.push("w", g)
+                    out[i] = c.pull("w", timeout=60.0)
+                t = threading.Thread(target=go)
+                t.start()
+                ts.append(t)
+            for t in ts:
+                t.join(timeout=90)
+        result = out[0].copy()
+        disseminated = [ls._g_autopull for ls in locals_]
+        pull_replies = sum(
+            sum(1 for (k, _c) in log if k == "w") for log in logs)
+        for c in cs:
+            c.stop_server()
+            c.close()
+        return result, disseminated, pull_replies
+
+    direct, _, _ = run(False, False)
+    ts, dissem, pull_replies = run(True, True)
+    assert all(dissem), "local servers did not register for dissemination"
+    assert pull_replies == 0, (
+        f"expected zero PULL replies for 'w' (dissemination only), got "
+        f"{pull_replies}")
+    np.testing.assert_allclose(ts, direct, rtol=1e-5, atol=1e-5)
+
+    # a global tier WITHOUT auto_pull declines registration: the relay
+    # falls back to min_round-gated pulls and still converges identically
+    ts2, dissem2, pr2 = run(True, False)
+    assert not any(dissem2) and pr2 > 0
+    np.testing.assert_allclose(ts2, direct, rtol=1e-5, atol=1e-5)
