@@ -7,10 +7,11 @@ The reference's demo workloads are Gluon CNNs on MNIST/FashionMNIST/CIFAR10
 from geomx_tpu.models.cnn import GeoCNN
 from geomx_tpu.models.mlp import MLP, AlexNet
 from geomx_tpu.models.resnet import ResNet, ResNet20, ResNet32, ResNet56, ResNet18
+from geomx_tpu.models.seq_classifier import SeqClassifier
 
 __all__ = ["GeoCNN", "MLP", "AlexNet",
            "ResNet", "ResNet20", "ResNet32", "ResNet56", "ResNet18",
-           "get_model"]
+           "SeqClassifier", "get_model"]
 
 
 def get_model(name: str, num_classes: int = 10):
@@ -29,4 +30,6 @@ def get_model(name: str, num_classes: int = 10):
         return ResNet56(num_classes=num_classes)
     if name == "resnet18":
         return ResNet18(num_classes=num_classes)
+    if name in ("seq", "seq_classifier", "transformer"):
+        return SeqClassifier(num_classes=num_classes)
     raise ValueError(f"Unknown model: {name!r}")

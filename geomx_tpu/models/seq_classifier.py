@@ -1,0 +1,150 @@
+"""Long-context sequence classifier with sequence-parallel attention.
+
+The drivable user of the sequence-parallel modules
+(`parallel/ring_attention.py`, `parallel/ulysses.py`): a small
+pre-LayerNorm transformer encoder whose attention runs, per the
+``sp_mode`` flag,
+
+- ``None``      — ordinary full attention (the un-meshed twin for
+                  Trainer's init/eval paths, and the numerical baseline);
+- ``"ring"``    — ring attention: K/V blocks rotate around the sp axis,
+                  O(L/n) activations per device;
+- ``"ulysses"`` — Ulysses: two all-to-alls re-shard sequence<->heads,
+                  full-sequence streaming attention per head shard.
+
+The SPMD contract with the train step (train/step.py): the step's
+shard_map shards the token batch's SEQUENCE dim over the "sp" mesh axis
+(``HiPSTopology(sp_degree=n)``), so this module receives its local
+[B, L/n] chunk plus a matching chunk of GLOBAL positions; the mean-pool
+is completed with a pmean over sp, making logits (and loss) identical on
+every sp device; the step then psums grads over sp.  Both hierarchies
+compose: dc/worker do HiPS data parallelism, sp does sequence
+parallelism — the long-context capability beyond the reference's scope
+(SURVEY.md §5 long-context; docs/long-context.md).
+
+Input layout: int32 tokens of shape [B, L] — or [B, L, 2] where
+``[..., 0]`` is the token id and ``[..., 1]`` its global position (what
+the sp-sharded path uses, so position embeddings are correct without an
+axis_index at init time).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from geomx_tpu.parallel.ring_attention import (full_attention_reference,
+                                               ring_attention)
+from geomx_tpu.parallel.ulysses import ulysses_attention
+from geomx_tpu.topology import SP_AXIS
+
+
+@jax.custom_vjp
+def _scale_bwd(x, s):
+    """Identity forward; backward multiplies the cotangent by ``s``.
+
+    The gradient bookkeeping for mixing sequence-sharded and replicated
+    regions in one shard_mapped step whose grads are psum'd over sp:
+    params DOWNSTREAM of the pooling pmean see the full loss gradient on
+    every sp device (psum would count them n times), while params
+    UPSTREAM see only their shard's contribution (psum is exactly
+    right — the pmean's transpose, a cotangent psum, already restores
+    the full upstream gradient per shard).  Scaling the OUTPUT cotangent
+    by 1/n fixes the replicated region without disturbing the sharded
+    one, so one uniform psum reconstructs the true gradient for both."""
+    return x
+
+
+def _scale_bwd_fwd(x, s):
+    return x, s
+
+
+def _scale_bwd_bwd(s, g):
+    return g * s, jnp.zeros_like(s)
+
+
+_scale_bwd.defvjp(_scale_bwd_fwd, _scale_bwd_bwd)
+
+
+class SPAttention(nn.Module):
+    num_heads: int
+    dim: int
+    sp_mode: Optional[str] = None   # None | "ring" | "ulysses"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h):
+        B, L, D = h.shape
+        hd = self.dim // self.num_heads
+        qkv = nn.DenseGeneral((3, self.num_heads, hd), use_bias=False,
+                              dtype=self.dtype, name="qkv")(h)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # [B, L, H, hd]
+        if self.sp_mode == "ring":
+            out = ring_attention(q, k, v, SP_AXIS, causal=self.causal)
+        elif self.sp_mode == "ulysses":
+            out = ulysses_attention(q, k, v, SP_AXIS, causal=self.causal)
+        elif self.sp_mode is None:
+            out = full_attention_reference(q, k, v, causal=self.causal)
+        else:
+            raise ValueError(f"unknown sp_mode {self.sp_mode!r}")
+        out = out.reshape(B, L, self.num_heads * hd)
+        return nn.DenseGeneral(D, use_bias=False, dtype=self.dtype,
+                               name="proj")(out)
+
+
+class SeqClassifier(nn.Module):
+    """Tiny encoder for sequence classification at long context."""
+
+    vocab: int = 256
+    max_len: int = 4096
+    dim: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    num_classes: int = 10
+    sp_mode: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:           # [B, L, 2]: (token, global position)
+            tokens, pos = x[..., 0], x[..., 1]
+        else:                     # [B, L]: positions are 0..L-1
+            tokens = x
+            pos = jnp.broadcast_to(jnp.arange(x.shape[1], dtype=jnp.int32),
+                                   x.shape)
+        h = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
+                     name="tok_embed")(tokens.astype(jnp.int32))
+        h = h + nn.Embed(self.max_len, self.dim, dtype=self.dtype,
+                         name="pos_embed")(pos.astype(jnp.int32))
+        for i in range(self.num_layers):
+            a = nn.LayerNorm(name=f"ln_a{i}")(h)
+            h = h + SPAttention(self.num_heads, self.dim,
+                                sp_mode=self.sp_mode, dtype=self.dtype,
+                                name=f"attn{i}")(a)
+            m = nn.LayerNorm(name=f"ln_m{i}")(h)
+            m = nn.Dense(self.dim * 4, dtype=self.dtype,
+                         name=f"mlp_in{i}")(m)
+            h = h + nn.Dense(self.dim, dtype=self.dtype,
+                             name=f"mlp_out{i}")(nn.gelu(m))
+        pooled = jnp.mean(nn.LayerNorm(name="ln_f")(h), axis=1)
+        if self.sp_mode is not None:
+            # local means over equal-size chunks -> global mean; logits
+            # (and the loss) become identical on every sp device.  The
+            # pmean's transpose (a cotangent psum) already hands each
+            # device the full upstream gradient for its shard path, so
+            # the only correction needed is the 1/n on the output below.
+            n = jnp.asarray(lax.psum(1, SP_AXIS), jnp.float32)
+            pooled = lax.pmean(pooled, SP_AXIS)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32,
+                          name="head")(pooled).astype(jnp.float32)
+        if self.sp_mode is not None:
+            # replicated-region params (the head) would otherwise get
+            # their FULL gradient on every sp device and be over-counted
+            # n-fold by the step's psum
+            logits = _scale_bwd(logits, 1.0 / n)
+        return logits

@@ -101,6 +101,9 @@ class GeoPSClient:
         # logged as (key, chunk_index|None) in arrival order — the pull
         # mirror of the server's push_log
         self.reply_log: Optional[list] = None
+        # best-effort DGT stat: deferred blocks shed client-side under
+        # send-queue congestion (never even entered the wire)
+        self.dgt_shed_blocks = 0
         # per-key push round ids: lets the server dedup a restarted
         # worker's replayed push exactly (see recover())
         self._key_rounds: Dict[str, int] = {}
@@ -266,7 +269,9 @@ class GeoPSClient:
         from a NEWER value) resets the set instead of blending."""
         if p.parts is None:
             from geomx_tpu.transport import ChunkAssembler
-            p.parts = ChunkAssembler()
+            # reply generations count up: a late chunk of a superseded
+            # reply must not reset a newer reply's assembly
+            p.parts = ChunkAssembler(monotonic_gen=True)
         out = p.parts.feed(msg.meta, msg.array)
         if out is None:
             return None
@@ -424,7 +429,7 @@ class GeoPSClient:
                  k: Optional[float] = None, block_elems: Optional[int] = None,
                  channels: Optional[int] = None,
                  alpha: Optional[float] = None, wait: bool = True,
-                 reliable: bool = False,
+                 reliable: bool = False, best_effort: Optional[bool] = None,
                  timeout: Optional[float] = 120.0):
         """DGT on the host wire (reference kv_app.h:1088-1196,
         van.cc:723-846, re-expressed for a reliable transport): the
@@ -437,8 +442,19 @@ class GeoPSClient:
         blocks are resend-protected, i.e. DGT-with-reliable-resend — the
         convergence-safe configuration; the server reassembles via the
         chunk path.  Defaults mirror DMLC_K=0.8, DGT_BLOCK_SIZE=4096B,
-        DMLC_UDP_CHANNEL_NUM=3, DGT_CONTRI_ALPHA=0.3."""
+        DMLC_UDP_CHANNEL_NUM=3, DGT_CONTRI_ALPHA=0.3.
+
+        ``best_effort=True`` (or GEOMX_DGT_BEST_EFFORT=1) is the
+        reference's actual lossy-channel bet (van.cc:723-846): deferred
+        (below-k) blocks ship fire-and-forget — droppable on the wire,
+        never retransmitted, never waited on, and shed client-side when
+        the send queue is congested (GEOMX_DGT_MAX_QUEUE frames) — while
+        the top-k blocks stay reliable.  The server finalizes the push
+        after a deadline, treating missing blocks as zeros; the error
+        lands in the next round's contribution EWMA."""
         from geomx_tpu.config import _env
+        if best_effort is None:
+            best_effort = bool(_env(("GEOMX_DGT_BEST_EFFORT",), 0, int))
         if k is None:
             k = _env(("GEOMX_DGT_K", "DMLC_K"), 0.8, float)
         if block_elems is None:
@@ -464,12 +480,15 @@ class GeoPSClient:
 
         rnd = self._key_rounds.get(key, 0) + 1
         self._key_rounds[key] = rnd
+        max_q = int(os.environ.get("GEOMX_DGT_MAX_QUEUE", "256"))
         rids = []
+        shed = 0
         for rank, b in enumerate(np.asarray(order)):
             start = int(b) * block_elems
             stop = min(n, start + block_elems)
             payload = flat[start:stop]
-            if rank < kn:
+            deferred = rank >= kn
+            if not deferred:
                 pr = priority + 1
             else:
                 ch = 1 + (rank - kn) % max(1, channels)
@@ -477,12 +496,33 @@ class GeoPSClient:
                 payload = payload.astype(np.float16)  # low-bit encode
             m = {"chunk": int(b), "num_chunks": nb, "start": start,
                  "n_total": n, "shape": list(g.shape), "round": rnd}
+            if best_effort:
+                m["num_required"] = kn
+                m["required"] = not deferred
             if reliable:
                 m["reliable"] = True  # e.g. the WAN relay hop: exempt
                 # from drop injection like every other relay message
+            if best_effort and deferred:
+                # lossy channel: fire-and-forget.  Droppable on the
+                # wire, no pending entry (the ACK, if any, is ignored),
+                # and shed outright under send-queue congestion.
+                m["best_effort"] = True
+                try:
+                    congested = len(self._sendq) >= max_q
+                except TypeError:
+                    congested = False
+                if congested:
+                    shed += 1
+                    continue
+                msg = Msg(MsgType.PUSH, key=key, meta=m, array=payload)
+                msg.sender = self.sender_id
+                msg.meta["rid"] = next(self._rid)
+                self._sendq.push(msg.encode(), pr)
+                continue
             rids.append(self._submit(
                 Msg(MsgType.PUSH, key=key, meta=m, array=payload),
                 priority=pr))
+        self.dgt_shed_blocks += shed
         mrid = next(self._rid)
         self._multi[mrid] = rids
         if not wait:

@@ -145,7 +145,11 @@ def should_drop(msg: Msg) -> bool:
         return False
     if msg.type not in (MsgType.PUSH, MsgType.PULL):
         return False
-    if not msg.meta.get("resend") or msg.meta.get("reliable"):
+    # best-effort DGT blocks are droppable WITHOUT resend protection —
+    # the reference's lossy UDP channels, where a dropped block is
+    # simply gone (van.cc:723-846)
+    droppable = msg.meta.get("resend") or msg.meta.get("best_effort")
+    if not droppable or msg.meta.get("reliable"):
         return False
     return _drop_rng.random() * 100.0 < rate
 

@@ -114,6 +114,9 @@ class GeoPSServer:
         # P3 reassembly buffers: (sender, key) -> partial state for an
         # in-flight chunked push (server side of kvstore_dist.h:835-872)
         self._p3_partial: Dict[Any, dict] = {}
+        # best-effort DGT pushes awaiting their deadline: (sender, key)
+        # -> {round, required_got, num_required, timer}
+        self._dgt_pending: Dict[Any, dict] = {}
         # arrival order of (sender, key, chunk) — TCP preserves the
         # client's send order, so tests/demos can assert P3 interleaving
         self.push_log: list = []
@@ -171,6 +174,10 @@ class GeoPSServer:
         # (pause_pull_stream command) making the reorder deterministic.
         self._out_qs: Dict[int, Any] = {}
         self._out_gates: Dict[int, threading.Event] = {}
+        # serializes queue creation against connection teardown so a
+        # completion thread can't install a queue for a conn whose serve
+        # thread is mid-cleanup (stale-queue / id-reuse hazard)
+        self._outq_lock = threading.Lock()
         self._pull_gen = itertools.count(1)
         # remotely-controllable profiler (reference kSetProfilerParams,
         # kvstore_dist_server.h:383-430)
@@ -346,15 +353,18 @@ class GeoPSServer:
         try:
             self._serve_conn_loop(conn)
         finally:
-            q = self._out_qs.pop(id(conn), None)
+            with self._outq_lock:
+                # leave _conns FIRST so _conn_out_q can't hand a fresh
+                # queue to this dying connection after the pops below
+                self._conns.discard(conn)
+                q = self._out_qs.pop(id(conn), None)
+                gate = self._out_gates.pop(id(conn), None)
             if q is not None:
                 q.close()  # wakes a drain thread blocked in pop()
-            gate = self._out_gates.pop(id(conn), None)
             if gate is not None:
                 gate.set()  # ...and one parked in a paused gate.wait()
                 # (its sendall then fails on the dead socket and it exits)
             self._conn_wlocks.pop(id(conn), None)  # don't leak per-conn locks
-            self._conns.discard(conn)
 
     def _serve_conn_loop(self, conn: socket.socket):
         while True:
@@ -387,7 +397,11 @@ class GeoPSServer:
             send_frame(conn, msg)
 
     def _reply(self, conn, req: Msg, reply: Msg):
-        """Echo the request id so async clients can match replies."""
+        """Echo the request id so async clients can match replies.
+        ``conn=None`` (a server-internal synthesized request, e.g. a
+        best-effort DGT deadline merge) sends nothing."""
+        if conn is None:
+            return
         rid = req.meta.get("rid")
         if rid is not None:
             reply.meta["rid"] = rid
@@ -970,10 +984,16 @@ class GeoPSServer:
             if msg.meta.get("chunk") is not None:
                 full = self._p3_accumulate(msg, grad)
                 if full is None:   # more chunks outstanding
+                    if msg.meta.get("num_required") is not None:
+                        # best-effort DGT: once the reliable (top-k)
+                        # blocks are all in, start the deadline after
+                        # which missing deferred blocks count as zeros
+                        self._dgt_track(msg)
                     self._reply(conn, msg, Msg(MsgType.ACK, key=key))
                     return
                 grad = full        # final chunk: merge the whole tensor;
                 # its ACK comes from _push_locked below
+                self._dgt_untrack((msg.sender, key))
             try:
                 self._push_locked(conn, msg, key, grad, rs=rs, sig=sig)
             except Exception:
@@ -984,6 +1004,68 @@ class GeoPSServer:
                 # only clear the buffer once the merge really happened, so
                 # a retransmitted final chunk can retry after a failure
                 self._p3_partial.pop((msg.sender, key), None)
+
+    def _dgt_track(self, msg: Msg):
+        """Best-effort DGT bookkeeping (caller holds self._lock): when
+        every REQUIRED (top-k, reliably-sent) chunk of a push has
+        arrived, arm a deadline that finalizes the push with zeros for
+        whatever deferred blocks never made it — the reference's lossy
+        UDP channels, where dropped blocks are simply gone
+        (van.cc:723-846)."""
+        pk = (msg.sender, msg.key)
+        rnd = int(msg.meta.get("round", 0))
+        st = self._dgt_pending.get(pk)
+        if st is None or st["round"] != rnd:
+            if st is not None and st["timer"] is not None:
+                st["timer"].cancel()
+            st = self._dgt_pending[pk] = {
+                "round": rnd, "required_got": set(),
+                "num_required": int(msg.meta["num_required"]),
+                "num_merge": int(msg.meta.get("num_merge", 1)),
+                "timer": None}
+        if msg.meta.get("required"):
+            st["required_got"].add(int(msg.meta["chunk"]))
+        if st["timer"] is None and \
+                len(st["required_got"]) >= st["num_required"]:
+            deadline_s = float(os.environ.get(
+                "GEOMX_DGT_DEADLINE_MS", "200")) / 1000.0
+            t = threading.Timer(deadline_s, self._dgt_finalize,
+                                args=(pk, rnd))
+            t.daemon = True
+            st["timer"] = t
+            t.start()
+
+    def _dgt_untrack(self, pk):
+        """The chunk set completed naturally: cancel the deadline."""
+        st = self._dgt_pending.pop(pk, None)
+        if st is not None and st["timer"] is not None:
+            st["timer"].cancel()
+
+    def _dgt_finalize(self, pk, rnd: int):
+        """Deadline fired: merge the push with its missing deferred
+        blocks as zeros.  No-op if the set completed in the meantime."""
+        with self._lock:
+            st = self._dgt_pending.get(pk)
+            if st is None or st["round"] != rnd:
+                return
+            del self._dgt_pending[pk]
+            part = self._p3_partial.get(pk)
+            if part is None or part.gen != rnd:
+                # the assembly moved on (a newer round's chunks arrived,
+                # or the set completed and merged): never force-merge a
+                # buffer from a different round than this deadline's
+                return
+            self._p3_partial.pop(pk, None)
+            grad = part.force()
+            if grad is None:
+                return
+            proto = Msg(MsgType.PUSH, key=pk[1],
+                        meta={"round": rnd,
+                              "num_merge": st["num_merge"]})
+            proto.sender = pk[0]
+            # conn=None: every arrived chunk was already ACKed (the
+            # client doesn't wait on deferred blocks); _reply no-ops
+            self._push_locked(None, proto, pk[1], grad)
 
     def _p3_accumulate(self, msg: Msg, piece: np.ndarray):
         """Collect one P3 chunk; returns the reassembled tensor when the
@@ -996,8 +1078,11 @@ class GeoPSServer:
         pk = (msg.sender, msg.key)
         part = self._p3_partial.get(pk)
         if part is None:
+            # monotonic per-key rounds: a stale straggler chunk (e.g. a
+            # deferred best-effort block from an already-finalized round)
+            # must not reset a newer round's assembly
             part = self._p3_partial[pk] = \
-                ChunkAssembler(clear_on_complete=False)
+                ChunkAssembler(clear_on_complete=False, monotonic_gen=True)
         return part.feed(msg.meta, piece)
 
     @staticmethod
@@ -1382,39 +1467,43 @@ class GeoPSServer:
         thread (the server half of the P3 send discipline: queued chunk
         replies leave in priority order, not submission order)."""
         qid = id(conn)
-        q = self._out_qs.get(qid)
-        if q is None:
-            if conn not in self._conns:
-                # the waiter is gone (its serve thread already cleaned
-                # up); creating a queue now would leave a stale entry
-                # that an id()-reusing NEW connection could inherit
-                raise OSError("connection closed")
-            from geomx_tpu.transport import PrioritySendQueue
-            q = self._out_qs[qid] = PrioritySendQueue()
-            gate = self._out_gates.get(qid)
-            if gate is None:  # don't undo a pause_pull_stream that
-                gate = self._out_gates[qid] = threading.Event()  # came first
-                gate.set()
+        with self._outq_lock:
+            q = self._out_qs.get(qid)
+            if q is None:
+                if conn not in self._conns:
+                    # the waiter is gone (its serve thread already cleaned
+                    # up); creating a queue now would leave a stale entry
+                    # that an id()-reusing NEW connection could inherit
+                    raise OSError("connection closed")
+                from geomx_tpu.transport import PrioritySendQueue
+                q = self._out_qs[qid] = PrioritySendQueue()
+                gate = self._out_gates.get(qid)
+                if gate is None:  # don't undo a pause_pull_stream that
+                    gate = self._out_gates[qid] = threading.Event()
+                    gate.set()
 
-            def drain():
-                while True:
-                    frame = q.pop()
-                    if frame is None:
-                        return
-                    gate.wait()
-                    lock = self._conn_wlocks.setdefault(
-                        qid, threading.Lock())
-                    with lock:
-                        try:
-                            conn.sendall(
-                                len(frame).to_bytes(4, "little") + frame)
-                        except OSError:
-                            # dead socket: drop our queue entry (only if
-                            # it is still ours — the serve thread may
-                            # have cleaned up and a new conn reused qid)
-                            if self._out_qs.get(qid) is q:
-                                self._out_qs.pop(qid, None)
-                            q.close()
+                def drain():
+                    while True:
+                        frame = q.pop()
+                        if frame is None:
                             return
-            threading.Thread(target=drain, daemon=True).start()
+                        gate.wait()
+                        lock = self._conn_wlocks.setdefault(
+                            qid, threading.Lock())
+                        with lock:
+                            try:
+                                conn.sendall(
+                                    len(frame).to_bytes(4, "little")
+                                    + frame)
+                            except OSError:
+                                # dead socket: drop our queue entry (only
+                                # if still ours — the serve thread may
+                                # have cleaned up and a new conn reused
+                                # the id)
+                                with self._outq_lock:
+                                    if self._out_qs.get(qid) is q:
+                                        self._out_qs.pop(qid, None)
+                                q.close()
+                                return
+                threading.Thread(target=drain, daemon=True).start()
         return q

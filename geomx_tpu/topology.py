@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # Axis names for the two HiPS tiers.
 DC_AXIS = "dc"          # cross-party / global tier (DCN)
 WORKER_AXIS = "worker"  # intra-party / local tier (ICI)
+SP_AXIS = "sp"          # sequence-parallel axis (ICI, innermost)
 
 # Both tiers, innermost-varying last: device order keeps a party's workers
 # adjacent so the worker axis rides ICI.
@@ -49,9 +50,16 @@ class HiPSTopology:
 
     num_parties: int = 1
     workers_per_party: int = 1
+    # sequence-parallel degree: a third mesh axis ("sp") over which long
+    # sequences shard for ring/Ulysses attention.  1 keeps the classic
+    # 2-D HiPS mesh; >1 builds (dc, worker, sp) with sp innermost so the
+    # per-token collectives ride ICI (beyond reference scope — the
+    # long-context capability; see docs/long-context.md)
+    sp_degree: int = 1
 
     def __post_init__(self):
-        if self.num_parties < 1 or self.workers_per_party < 1:
+        if self.num_parties < 1 or self.workers_per_party < 1 \
+                or self.sp_degree < 1:
             raise ValueError("topology sizes must be >= 1")
 
     @property
@@ -76,13 +84,18 @@ class HiPSTopology:
         return cls(num_parties=num_parties, workers_per_party=n // num_parties)
 
     def build_mesh(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-        """Build the 2-D mesh. Requires num_parties*workers_per_party devices."""
+        """Build the mesh: 2-D (dc, worker), or 3-D (dc, worker, sp) when
+        ``sp_degree > 1``.  Requires parties*workers*sp devices."""
         if devices is None:
             devices = jax.devices()
-        need = self.num_parties * self.workers_per_party
+        need = self.num_parties * self.workers_per_party * self.sp_degree
         if len(devices) < need:
             raise ValueError(
                 f"topology needs {need} devices, only {len(devices)} available")
+        if self.sp_degree > 1:
+            grid = np.asarray(devices[:need]).reshape(
+                self.num_parties, self.workers_per_party, self.sp_degree)
+            return Mesh(grid, axis_names=REPLICA_AXES + (SP_AXIS,))
         grid = np.asarray(devices[:need]).reshape(
             self.num_parties, self.workers_per_party)
         return Mesh(grid, axis_names=REPLICA_AXES)
@@ -99,3 +112,10 @@ class HiPSTopology:
     def batch_sharding(self, mesh: Mesh) -> NamedSharding:
         """Sharding for global batches shaped [parties, workers, local_b, ...]."""
         return NamedSharding(mesh, P(DC_AXIS, WORKER_AXIS))
+
+    def seq_batch_sharding(self, mesh: Mesh) -> NamedSharding:
+        """Sharding for token batches [parties, workers, local_b, L(, ...)]
+        with the SEQUENCE dim sharded over the sp axis."""
+        if self.sp_degree <= 1:
+            return self.batch_sharding(mesh)
+        return NamedSharding(mesh, P(DC_AXIS, WORKER_AXIS, None, SP_AXIS))

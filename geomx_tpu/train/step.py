@@ -22,12 +22,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from geomx_tpu.parallel.collectives import shard_map_compat
 from geomx_tpu.sync.base import SyncAlgorithm
-from geomx_tpu.topology import DC_AXIS, WORKER_AXIS, HiPSTopology
+from geomx_tpu.topology import DC_AXIS, SP_AXIS, WORKER_AXIS, HiPSTopology
 from geomx_tpu.train.state import TrainState, state_specs
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def _norm_input(x: jax.Array) -> jax.Array:
+    """Image inputs (uint8 or float, 0-255 scale) normalize to [0,1]
+    on-device, preserving the historical convention for float-array
+    callers; WIDE integer dtypes are token ids and pass through
+    untouched (embeddings index them directly)."""
+    if jnp.issubdtype(x.dtype, jnp.integer) and x.dtype != jnp.uint8:
+        return x
+    return x.astype(jnp.float32) / 255.0
 
 
 def make_loss_fn(apply_fn: Callable, mutable_keys=("batch_stats",)):
@@ -38,7 +48,7 @@ def make_loss_fn(apply_fn: Callable, mutable_keys=("batch_stats",)):
     """
 
     def loss_fn(params, model_state, x, y):
-        x = x.astype(jnp.float32) / 255.0
+        x = _norm_input(x)
         variables = {"params": params, **model_state}
         mut = [k for k in mutable_keys if k in model_state]
         if mut:
@@ -70,6 +80,7 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     """
     sync.bind_topology(topology)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    sp = getattr(topology, "sp_degree", 1)
 
     mgps = None
     if config is not None and getattr(config, "multi_gps", False):
@@ -152,6 +163,19 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         (loss, (model_state, logits)), grads = grad_fn(
             fwd_params, model_state, xb, yb)
 
+        if sp > 1:
+            # sequence parallelism: each sp device back-propagated only
+            # its sequence shard's path (the model's forward psum/
+            # attention collectives ride the sp axis); the true gradient
+            # is the SUM of the shard contributions.  After this, grads
+            # are identical across sp and the dc/worker sync tiers see
+            # one consistent replica per (party, worker).
+            grads = lax.psum(grads, SP_AXIS)
+            model_state = jax.tree.map(
+                lambda a: lax.pmean(a, SP_AXIS)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                model_state)
+
         if mgps is not None:
             params, opt_state, sync_state = _mgps_sync_update(
                 grads, params, opt_state, sync_state, step)
@@ -165,6 +189,8 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         acc = jnp.mean(jnp.argmax(logits, -1) == yb)
         metrics = {"loss": loss, "accuracy": acc}
         # global mean over every worker for reporting
+        if sp > 1:
+            metrics = jax.lax.pmean(metrics, SP_AXIS)
         metrics = jax.lax.pmean(jax.lax.pmean(metrics, WORKER_AXIS), DC_AXIS)
 
         new_state = TrainState(
@@ -178,9 +204,15 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
 
     specs = state_specs()
     batch_spec = P(DC_AXIS, WORKER_AXIS)
+    x_spec = batch_spec
+    if sp > 1:
+        # token batches [P, W, B, L(, ...)]: the sequence dim shards
+        # over sp; state and labels replicate across sp (grads are
+        # psum'd back to consistency inside the step)
+        x_spec = P(DC_AXIS, WORKER_AXIS, None, SP_AXIS)
     mapped = shard_map_compat(
         _device_step, mesh,
-        in_specs=(specs, batch_spec, batch_spec),
+        in_specs=(specs, x_spec, batch_spec),
         out_specs=(specs, P()),
     )
     if donate:
@@ -195,7 +227,7 @@ def build_eval_step(apply_fn: Callable):
 
     @jax.jit
     def logits_fn(params, model_state, x):
-        x = x.astype(jnp.float32) / 255.0
+        x = _norm_input(x)
         variables = {"params": params, **model_state}
         return apply_fn(variables, x, train=False)
 

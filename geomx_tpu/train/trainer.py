@@ -31,8 +31,15 @@ class Trainer:
                  optimizer: optax.GradientTransformation,
                  sync: Optional[SyncAlgorithm] = None,
                  config: Optional[GeoConfig] = None,
-                 mesh=None, donate: bool = True):
+                 mesh=None, donate: bool = True,
+                 single_device_model=None):
+        """``single_device_model``: a twin of ``model`` with the same
+        parameter structure but no in-graph collectives, used for the
+        un-meshed paths (init, eval, predict).  Required when ``model``
+        calls axis collectives (e.g. sequence-parallel attention over the
+        sp axis), which only trace inside the sharded train step."""
         self.model = model
+        self._sd_model = single_device_model or model
         self.topology = topology
         self.config = config or GeoConfig(
             num_parties=topology.num_parties,
@@ -49,19 +56,22 @@ class Trainer:
             from geomx_tpu.parallel.multigps import MultiGPSPlan
             self._mgps = MultiGPSPlan(self.config.bigarray_bound,
                                       topology.workers_per_party)
-        self.eval_step, self._logits_fn = build_eval_step(model.apply)
+        self.eval_step, self._logits_fn = build_eval_step(
+            self._sd_model.apply)
         self._batch_sharding = topology.batch_sharding(self.mesh)
         self._epoch_runners: dict = {}
         self._eval_cache: dict = {}    # device-resident test set
         self._eval_sweeps: dict = {}   # batch_size -> scanned eval program
 
     def init_state(self, rng: jax.Array, sample_input: np.ndarray) -> TrainState:
-        """sample_input: one local batch [b, H, W, C] (uint8 or float)."""
-        x0 = jnp.asarray(sample_input, jnp.float32) / 255.0
+        """sample_input: one local batch [b, H, W, C] (uint8 images) or
+        [b, L] (integer token ids — passed through un-normalized)."""
+        from geomx_tpu.train.step import _norm_input
+        x0 = _norm_input(jnp.asarray(sample_input))
         # jit the init: one compiled program instead of thousands of eager
         # dispatches (critical on remote/tunneled devices)
         variables = jax.jit(
-            lambda r, x: self.model.init(r, x, train=False))(rng, x0)
+            lambda r, x: self._sd_model.init(r, x, train=False))(rng, x0)
         variables = dict(variables)
         params = variables.pop("params")
         model_state = variables  # batch_stats etc.

@@ -89,15 +89,34 @@ class ChunkAssembler:
     happened, so a retransmitted final chunk can retry after a failure).
     """
 
-    def __init__(self, clear_on_complete: bool = True):
+    def __init__(self, clear_on_complete: bool = True,
+                 monotonic_gen: bool = False):
+        """``monotonic_gen=True``: generations are ordered (per-key push
+        rounds); a chunk from an OLDER generation than the current
+        assembly is dropped instead of resetting it — a stale straggler
+        block must never destroy a fresh round's arrived chunks."""
         self.clear_on_complete = clear_on_complete
+        self.monotonic_gen = monotonic_gen
         self._st: Optional[dict] = None
+
+    @property
+    def gen(self):
+        """The in-flight assembly's generation (None if empty)."""
+        return None if self._st is None else self._st["sig"][2]
 
     def feed(self, meta: dict, piece: np.ndarray):
         n = int(meta["n_total"])
         num = int(meta["num_chunks"])
-        sig = (n, num, meta.get("gen"))
-        if self._st is None or self._st["sig"] != sig:
+        # pushes carry the key round, pull replies a reply generation —
+        # either way a chunk from a different transfer resets the set
+        sig = (n, num, meta.get("gen", meta.get("round")))
+        if self._st is not None and self._st["sig"] != sig:
+            if self.monotonic_gen and isinstance(sig[2], int) \
+                    and isinstance(self._st["sig"][2], int) \
+                    and sig[2] < self._st["sig"][2]:
+                return None  # stale straggler: drop, keep the fresh set
+            self._st = None
+        if self._st is None:
             self._st = {"sig": sig, "buf": np.zeros((n,), np.float32),
                         "got": set(), "shape": tuple(meta["shape"])}
         st = self._st
@@ -110,6 +129,17 @@ class ChunkAssembler:
         out = st["buf"].reshape(st["shape"])
         if self.clear_on_complete:
             self._st = None
+        return out
+
+    def force(self):
+        """Finalize an INCOMPLETE assembly: the buffer as-is, with
+        never-arrived chunks as zeros — the best-effort DGT semantics
+        where a lost low-contribution block is simply gone.  Returns
+        None if nothing was fed.  Clears the assembly."""
+        if self._st is None:
+            return None
+        out = self._st["buf"].reshape(self._st["shape"])
+        self._st = None
         return out
 
 

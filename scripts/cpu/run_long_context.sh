@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Long-context sequence parallelism: ring or Ulysses attention over the
+# "sp" mesh axis under HiPS data parallelism — 2 parties x 2 workers x 2
+# sp shards on a virtual 8-device CPU mesh.  Beyond reference scope (the
+# long-context capability, docs/long-context.md).
+# Usage: run_long_context.sh [ring|ulysses]
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+cd "$REPO_ROOT"
+
+: "${GEOMX_NUM_PARTIES:=2}"
+: "${GEOMX_WORKERS_PER_PARTY:=2}"
+: "${GEOMX_SP_DEGREE:=2}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY GEOMX_SP_DEGREE
+
+n=$((GEOMX_NUM_PARTIES * GEOMX_WORKERS_PER_PARTY * GEOMX_SP_DEGREE))
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${n}" \
+  python examples/long_context.py "${1:-ring}"

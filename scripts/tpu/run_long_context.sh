@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Long-context sequence parallelism on real TPU chips: the sp axis rides
+# ICI.  Topology must fit jax.device_count() (parties*workers*sp).
+# Usage: run_long_context.sh [ring|ulysses]
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+cd "$REPO_ROOT"
+
+: "${GEOMX_NUM_PARTIES:=1}"
+: "${GEOMX_WORKERS_PER_PARTY:=1}"
+: "${GEOMX_SP_DEGREE:=1}"
+export GEOMX_NUM_PARTIES GEOMX_WORKERS_PER_PARTY GEOMX_SP_DEGREE
+python examples/long_context.py "${1:-ring}"

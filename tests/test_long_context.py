@@ -1,0 +1,103 @@
+"""Sequence parallelism through Trainer (VERDICT r3 #9).
+
+The SP modules get a real user: SeqClassifier's attention runs ring /
+Ulysses over the "sp" mesh axis inside the HiPS train step.  The key
+claim is NUMERICAL: training with the sequence sharded across sp devices
+follows exactly the same trajectory as the un-sharded model on the plain
+2-D mesh — sequence parallelism changes the schedule, never the math.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from geomx_tpu.models import SeqClassifier
+from geomx_tpu.sync import FSA
+from geomx_tpu.topology import HiPSTopology
+from geomx_tpu.train import Trainer
+
+BATCH, L, STEPS = 8, 64, 3
+MK = dict(vocab=64, max_len=L, dim=32, num_heads=4, num_layers=2,
+          num_classes=4)
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(4, 64, size=(BATCH * STEPS, L)).astype(np.int32)
+    y = rng.randint(0, 4, size=(BATCH * STEPS,)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), x.shape)
+    return np.stack([x, pos], axis=-1), y
+
+
+def _train(sp_mode, parties, workers, sp):
+    topo = HiPSTopology(num_parties=parties, workers_per_party=workers,
+                        sp_degree=sp)
+    trainer = Trainer(
+        SeqClassifier(sp_mode=sp_mode, **MK), topo,
+        optax.sgd(0.1), sync=FSA(),
+        single_device_model=SeqClassifier(sp_mode=None, **MK))
+    x, y = _data()
+    state = trainer.init_state(jax.random.PRNGKey(0), x[:2])
+    local_b = BATCH // (parties * workers)
+    xs = topo.seq_batch_sharding(trainer.mesh)
+    ys = topo.batch_sharding(trainer.mesh)
+    losses = []
+    for s in range(STEPS):
+        xb = x[s * BATCH:(s + 1) * BATCH].reshape(
+            parties, workers, local_b, L, 2)
+        yb = y[s * BATCH:(s + 1) * BATCH].reshape(parties, workers, local_b)
+        state, metrics = trainer.train_step(
+            state, jax.device_put(xb, xs), jax.device_put(yb, ys))
+        losses.append(float(metrics["loss"]))
+    params = jax.tree.map(lambda a: np.asarray(a[0, 0]), state.params)
+    return losses, params
+
+
+@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+def test_sp_training_matches_unsharded(sp_mode):
+    """(2 workers x 4 sp) == (2 workers, no sp): identical losses and
+    final params up to float tolerance."""
+    base_losses, base_params = _train(None, 1, 2, 1)
+    sp_losses, sp_params = _train(sp_mode, 1, 2, 4)
+    np.testing.assert_allclose(sp_losses, base_losses, rtol=2e-4, atol=2e-4)
+    flat_b = jax.tree.leaves(base_params)
+    flat_s = jax.tree.leaves(sp_params)
+    for b, s in zip(flat_b, flat_s):
+        np.testing.assert_allclose(s, b, rtol=2e-3, atol=2e-3)
+
+
+def test_sp_composes_with_hips_mesh():
+    """Full 3-D composition (2 dc x 2 worker x 2 sp): data parallelism
+    across both HiPS tiers with the sequence sharded inside each replica
+    follows the plain 2-D HiPS trajectory exactly."""
+    base_losses, _ = _train(None, 2, 2, 1)
+    sp_losses, _ = _train("ring", 2, 2, 2)
+    np.testing.assert_allclose(sp_losses, base_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_example_converges():
+    """The shipped example learns the needle task (the attention-required
+    signal) on the virtual mesh."""
+    import os
+
+    os.environ["GEOMX_EPOCHS"] = "3"
+    os.environ["GEOMX_SEQ_LEN"] = "96"
+    os.environ["GEOMX_NUM_PARTIES"] = "1"
+    os.environ["GEOMX_WORKERS_PER_PARTY"] = "2"
+    os.environ["GEOMX_SP_DEGREE"] = "2"
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "long_context_example",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "examples", "long_context.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        acc = mod.main("ulysses")
+    finally:
+        for k in ("GEOMX_EPOCHS", "GEOMX_SEQ_LEN", "GEOMX_NUM_PARTIES",
+                  "GEOMX_WORKERS_PER_PARTY", "GEOMX_SP_DEGREE"):
+            os.environ.pop(k, None)
+    assert acc > 0.5, f"needle task should be learnable, got {acc}"
