@@ -279,11 +279,19 @@ class GeoPSClient:
         return Msg(MsgType.PULL_REPLY, key=msg.key,
                    meta={"rid": msg.meta.get("rid")}, array=out)
 
-    def _submit(self, msg: Msg, priority: int = 0) -> int:
-        """Enqueue a request; returns its timestamp (request id)."""
+    def _submit(self, msg: Msg, priority: int = 0,
+                fire_and_forget: bool = False) -> int:
+        """Enqueue a request; returns its timestamp (request id).
+
+        ``fire_and_forget``: no pending entry, no resend marking — the
+        reply (if any) is ignored by the recv loop.  The best-effort DGT
+        deferred blocks' lossy-channel send."""
         rid = next(self._rid)
         msg.sender = self.sender_id
         msg.meta["rid"] = rid
+        if fire_and_forget:
+            self._sendq.push(msg.encode(), priority)
+            return rid
         p = _Pending()
         # only data messages are retransmitted: PUSH is deduped server-side
         # (flagged here), PULL is idempotent; control traffic (barrier,
@@ -514,10 +522,9 @@ class GeoPSClient:
                 if congested:
                     shed += 1
                     continue
-                msg = Msg(MsgType.PUSH, key=key, meta=m, array=payload)
-                msg.sender = self.sender_id
-                msg.meta["rid"] = next(self._rid)
-                self._sendq.push(msg.encode(), pr)
+                self._submit(Msg(MsgType.PUSH, key=key, meta=m,
+                                 array=payload),
+                             priority=pr, fire_and_forget=True)
                 continue
             rids.append(self._submit(
                 Msg(MsgType.PUSH, key=key, meta=m, array=payload),

@@ -1015,6 +1015,12 @@ class GeoPSServer:
         pk = (msg.sender, msg.key)
         rnd = int(msg.meta.get("round", 0))
         st = self._dgt_pending.get(pk)
+        if st is not None and rnd < st["round"]:
+            # stale straggler from an already-superseded round (deferred
+            # blocks ride lower priority and can arrive arbitrarily
+            # late): it must not wipe the current round's required set
+            # or cancel its armed deadline
+            return
         if st is None or st["round"] != rnd:
             if st is not None and st["timer"] is not None:
                 st["timer"].cancel()

@@ -55,7 +55,10 @@ def test_best_effort_drops_deferred_blocks_but_round_completes(monkeypatch):
             dropped += 1
     assert dropped > 0, "30% injection should lose at least one block"
     chunks = [e for e in server.push_log if e[1] == "w" and e[2] is not None]
-    assert len(chunks) == nb - dropped < nb
+    # wire-dropped blocks never reach push_log; a deferred block that
+    # arrives AFTER the deadline finalize is logged yet reads back zero,
+    # so logged >= delivered-in-time and < the full set (whp under 30%)
+    assert nb - dropped <= len(chunks) < nb
     c.stop_server()
     c.close()
 
