@@ -80,30 +80,21 @@ def main(sp_mode=None):
     x3 = with_positions(x)
     local_b = batch // (parties * workers)
 
-    xs = trainer.topology.seq_batch_sharding(trainer.mesh)
-    ys = trainer.topology.batch_sharding(trainer.mesh)
+    # make_loader shards x's sequence dim over the sp axis automatically
+    # on an sp topology; fit consumes metrics per step (rendezvous-safe
+    # on the virtual CPU mesh) and evaluates per epoch
+    loader = trainer.make_loader(x3, y, local_b)
     state = trainer.init_state(jax.random.PRNGKey(0), x3[:2])
 
-    steps = len(x) // batch
     print(f"[long-context] {sp_mode} attention on "
           f"{parties}x{workers}x{sp} mesh, L={seq_len} "
-          f"({seq_len // sp}/device), {steps} steps/epoch", flush=True)
-    for ep in range(epochs):
-        perm = np.random.RandomState(ep).permutation(len(x))
-        for s in range(steps):
-            idx = perm[s * batch:(s + 1) * batch]
-            xb = x3[idx].reshape(parties, workers, local_b, seq_len, 2)
-            yb = y[idx].reshape(parties, workers, local_b)
-            state, metrics = trainer.train_step(
-                state, jax.device_put(xb, xs), jax.device_put(yb, ys))
-            # consume metrics per step: many queued collective steps
-            # starve XLA:CPU's rendezvous on the virtual mesh (Trainer.fit
-            # does the same)
-            jax.block_until_ready(metrics["loss"])
-        acc = trainer.evaluate(state, with_positions(xt), yt)
-        print(f"[long-context] epoch {ep} loss "
-              f"{float(metrics['loss']):.4f} test_acc {acc:.3f}", flush=True)
-    return acc
+          f"({seq_len // sp}/device), {loader.steps_per_epoch} "
+          "steps/epoch", flush=True)
+    state, hist = trainer.fit(
+        state, loader, epochs=epochs,
+        eval_data=(with_positions(xt), yt),
+        log_fn=lambda s: print(f"[long-context] {s}", flush=True))
+    return hist[-1]["test_acc"]
 
 
 if __name__ == "__main__":

@@ -48,20 +48,33 @@ class BiSparseCompressor(Compressor):
     name = "bsc"
 
     def __init__(self, ratio: float = 0.01, approx: "bool | None" = None,
-                 min_sparse_size: int = 1024):
+                 min_sparse_size: int = 1024,
+                 select: "str | None" = None):
+        """``select``: "exact" (lax.top_k), "approx" (lax.approx_max_k),
+        or "sampled" (the reference's sampled-boundary scan,
+        ops/sampled_topk.py).  Default: GEOMX_BSC_SELECT if set, else
+        "approx" on TPU and "exact" elsewhere (deterministic behavioral
+        tests vs the reference recurrences run on CPU).  ``approx`` is
+        the legacy boolean spelling of exact/approx."""
+        import os
         if ratio <= 0:
             raise ValueError("threshold must be greater than 0")
         self.ratio = float(ratio)
-        if approx is None:
-            # TPU defaults to the hardware-friendly approximate top-k
-            # (~10x faster than exact lax.top_k at multi-million element
-            # sizes; recall>=0.95, and error feedback re-sends what a
-            # round misses).  CPU keeps exact selection — deterministic
-            # behavioral tests vs the reference recurrences run there.
-            # GEOMX_BSC_APPROX_TOPK=0 forces exact selection anywhere.
-            from geomx_tpu.compression.base import default_on_tpu
-            approx = default_on_tpu("GEOMX_BSC_APPROX_TOPK")
-        self.approx = approx
+        if select is None:
+            if approx is not None:
+                select = "approx" if approx else "exact"
+            else:
+                # empty string (an unset-but-exported launcher variable)
+                # falls back to the platform default
+                select = os.environ.get("GEOMX_BSC_SELECT") or None
+            if select is None:
+                from geomx_tpu.compression.base import default_on_tpu
+                select = "approx" if default_on_tpu(
+                    "GEOMX_BSC_APPROX_TOPK") else "exact"
+        if select not in ("exact", "approx", "sampled"):
+            raise ValueError(f"unknown BSC selection {select!r}")
+        self.select = select
+        self.approx = select == "approx"
         # tensors smaller than this aren't worth sparsifying: 2*k payload
         # would approach the dense size; send dense fp32 instead
         self.min_sparse_size = int(min_sparse_size)
@@ -89,7 +102,16 @@ class BiSparseCompressor(Compressor):
         u = u * MOMENTUM + g_flat
         v = v + u
         absv = jnp.abs(v)
-        if self.approx:
+        if self.select == "sampled":
+            # the reference's own algorithm (sampled boundary + one
+            # zipping scan, gc.cc:219-259) — O(n), no sort/top-k
+            from geomx_tpu.ops.sampled_topk import sampled_threshold_select
+            vals, idx, keep = sampled_threshold_select(v, absv, k)
+            # error feedback: emitted coordinates reset (gc.cc:250-252)
+            v = jnp.where(keep, 0.0, v)
+            u = jnp.where(keep, 0.0, u)
+            return vals, idx, u, v
+        if self.select == "approx":
             _, idx = lax.approx_max_k(absv, k)
         else:
             _, idx = lax.top_k(absv, k)

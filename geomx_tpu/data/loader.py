@@ -82,10 +82,19 @@ class GeoDataLoader:
         random crop from a ``pad``-pixel reflection border + horizontal
         flip (the reference's gluon transforms path,
         python/mxnet/gluon/data/vision/transforms.py RandomResizedCrop /
-        RandomFlipLeftRight as used by its CIFAR training recipes)."""
+        RandomFlipLeftRight as used by its CIFAR training recipes).
+
+        ``sharding`` may be a single sharding for both tensors, or an
+        (x_sharding, y_sharding) pair — sequence-parallel token batches
+        shard x's sequence dim over the sp axis while labels stay on the
+        replica grid."""
         self.topology = topology
         self.batch_size = int(batch_size)
-        self.sharding = sharding
+        if isinstance(sharding, (tuple, list)):
+            self.x_sharding, self.y_sharding = sharding
+        else:
+            self.x_sharding = self.y_sharding = sharding
+        self.sharding = self.x_sharding
         self.shuffle = shuffle
         self.seed = seed
         self.augment = augment
@@ -109,15 +118,15 @@ class GeoDataLoader:
         self.device_cache = device_cache
         if device_cache:
             rep = None
-            if isinstance(sharding, jax.sharding.NamedSharding):
+            if isinstance(self.x_sharding, jax.sharding.NamedSharding):
                 rep = jax.sharding.NamedSharding(
-                    sharding.mesh, jax.sharding.PartitionSpec())
+                    self.x_sharding.mesh, jax.sharding.PartitionSpec())
             self._dev_x = jax.device_put(x, rep)
             self._dev_y = jax.device_put(y, rep)
             self._gather = jax.jit(
                 gather_batch, static_argnames=("augment", "pad"),
-                out_shardings=None if sharding is None
-                else (sharding, sharding))
+                out_shardings=None if self.x_sharding is None
+                else (self.x_sharding, self.y_sharding))
 
     def epoch(self, epoch: int = 0,
               prefetch: int = 2) -> Iterator[Tuple[jax.Array, jax.Array]]:
@@ -214,9 +223,9 @@ class GeoDataLoader:
                 (topo.num_parties, topo.workers_per_party, b) + self.x.shape[1:])
             yb = self.y[sel.reshape(-1)].reshape(
                 (topo.num_parties, topo.workers_per_party, b))
-            if self.sharding is not None:
-                xb = jax.device_put(xb, self.sharding)
-                yb = jax.device_put(yb, self.sharding)
+            if self.x_sharding is not None:
+                xb = jax.device_put(xb, self.x_sharding)
+                yb = jax.device_put(yb, self.y_sharding)
             yield xb, yb
 
     def _augment_batch(self, x: np.ndarray,

@@ -100,9 +100,15 @@ class Trainer:
     def make_loader(self, x, y, batch_size: int, split_by_class: bool = False,
                     seed: int = 0, augment: bool = False,
                     device_cache: bool = False) -> GeoDataLoader:
+        sharding = self._batch_sharding
+        if getattr(self.topology, "sp_degree", 1) > 1:
+            # token batches: x's sequence dim shards over the sp axis,
+            # labels stay on the (dc, worker) replica grid
+            sharding = (self.topology.seq_batch_sharding(self.mesh),
+                        self._batch_sharding)
         return GeoDataLoader(x, y, self.topology, batch_size,
                              split_by_class=split_by_class, seed=seed,
-                             sharding=self._batch_sharding, augment=augment,
+                             sharding=sharding, augment=augment,
                              device_cache=device_cache)
 
     def predict_logits(self, state: TrainState, x: np.ndarray,
@@ -187,14 +193,19 @@ class Trainer:
         builds from engine threads + prefetching iterators.  Cached by
         (augment, pad) — the only loader-dependent trace inputs — so the
         closure never pins a loader (or its HBM dataset) in memory."""
-        cache_key = (loader.augment, loader.pad)
+        # honor the loader's x/y split (sp topologies shard x's sequence
+        # dim over the sp axis while labels stay on the replica grid);
+        # the shardings join the cache key so loaders with different
+        # layouts don't share a traced runner
+        x_sharding = getattr(loader, "x_sharding", self._batch_sharding)
+        y_sharding = getattr(loader, "y_sharding", self._batch_sharding)
+        cache_key = (loader.augment, loader.pad, x_sharding, y_sharding)
         run = self._epoch_runners.get(cache_key)
         if run is not None:
             return run
         from geomx_tpu.data.loader import gather_batch
         step_fn = self.train_step
-        sharding = self._batch_sharding
-        augment, pad = cache_key
+        augment, pad = loader.augment, loader.pad
 
         import functools
 
@@ -204,9 +215,9 @@ class Trainer:
                 s, i = inp
                 xb, yb = gather_batch(dx, dy, s, jax.random.fold_in(key, i),
                                       augment=augment, pad=pad)
-                if sharding is not None:
-                    xb = jax.lax.with_sharding_constraint(xb, sharding)
-                    yb = jax.lax.with_sharding_constraint(yb, sharding)
+                if x_sharding is not None:
+                    xb = jax.lax.with_sharding_constraint(xb, x_sharding)
+                    yb = jax.lax.with_sharding_constraint(yb, y_sharding)
                 return step_fn(st, xb, yb)
             return jax.lax.scan(body, state,
                                 (sel, jnp.arange(sel.shape[0])))

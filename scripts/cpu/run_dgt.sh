@@ -11,6 +11,11 @@ export GEOMX_ENABLE_DGT=2
 export GEOMX_DGT_K="${GEOMX_DGT_K:-0.8}"
 export GEOMX_UDP_CHANNEL_NUM="${GEOMX_UDP_CHANNEL_NUM:-3}"
 export GEOMX_ADAPTIVE_K="${GEOMX_ADAPTIVE_K:-1}"
+# GEOMX_DGT_BEST_EFFORT=1 makes the host-plane deferred blocks genuinely
+# lossy (fire-and-forget, server fills missing blocks with zeros after
+# GEOMX_DGT_DEADLINE_MS) — the reference's UDP-channel semantics; default
+# stays the convergence-safe reliable delivery
+export GEOMX_DGT_BEST_EFFORT="${GEOMX_DGT_BEST_EFFORT:-0}"
 
 # host plane: workers push through the DGT wire scheduler (contribution-
 # ranked priority blocks, fp16 low channels) on the real PS topology
