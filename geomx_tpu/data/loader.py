@@ -94,7 +94,6 @@ class GeoDataLoader:
             self.x_sharding, self.y_sharding = sharding
         else:
             self.x_sharding = self.y_sharding = sharding
-        self.sharding = self.x_sharding
         self.shuffle = shuffle
         self.seed = seed
         self.augment = augment

@@ -24,6 +24,9 @@ def get_model(name: str, num_classes: int = 10):
         return AlexNet(num_classes=num_classes)
     if name == "resnet20":
         return ResNet20(num_classes=num_classes)
+    if name in ("resnet20_s2d", "resnet20-s2d"):
+        # TPU stem experiment: 2x2 space-to-depth (see models/resnet.py)
+        return ResNet20(num_classes=num_classes, space_to_depth=True)
     if name == "resnet32":
         return ResNet32(num_classes=num_classes)
     if name == "resnet56":

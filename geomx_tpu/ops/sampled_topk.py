@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def sampled_threshold_select(v: jax.Array, absv: jax.Array, k: int,
@@ -34,14 +35,23 @@ def sampled_threshold_select(v: jax.Array, absv: jax.Array, k: int,
     """
     n = absv.shape[0]
     k = int(k)
-    stride = max(1, n // int(sample))
-    samp = absv[::stride]
-    m = samp.shape[0]
+    m = min(n, int(sample))
+    # quasi-random sample positions (Weyl/multiplicative sequence): a
+    # plain stride slice would systematically miss magnitude structure
+    # correlated with position mod stride; this decorrelates from any
+    # fixed layout while staying deterministic (the reference seeds its
+    # random sampler the same way every run)
+    pos_idx = (np.arange(m, dtype=np.int64) * 2654435761) % n
+    samp = absv[jnp.asarray(pos_idx, jnp.int32)]
     ssorted = jnp.sort(samp)
     # boundary at the (1 - k/n) quantile of the sample
     pos = int(round(m * (1.0 - k / n)))
     thr = ssorted[min(max(pos, 0), m - 1)]
-    mask = absv >= thr
+    # STRICT comparison: with a tied boundary (the common case being
+    # thr == 0 on sparse/ReLU gradients, where >99% of entries are
+    # exactly 0) an inclusive mask would fill all k slots with the
+    # first k zeros by index order and starve the real mass forever
+    mask = absv > thr
     mask_i = mask.astype(jnp.int32)
     rank = jnp.cumsum(mask_i) - mask_i          # exclusive rank among hits
     keep = mask & (rank < k)

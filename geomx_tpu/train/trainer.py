@@ -101,9 +101,13 @@ class Trainer:
                     seed: int = 0, augment: bool = False,
                     device_cache: bool = False) -> GeoDataLoader:
         sharding = self._batch_sharding
-        if getattr(self.topology, "sp_degree", 1) > 1:
-            # token batches: x's sequence dim shards over the sp axis,
-            # labels stay on the (dc, worker) replica grid
+        if getattr(self.topology, "sp_degree", 1) > 1 \
+                and np.issubdtype(np.asarray(x).dtype, np.integer) \
+                and np.asarray(x).ndim in (2, 3):
+            # integer token batches [N, L(, feat)]: x's sequence dim
+            # shards over the sp axis, labels stay on the (dc, worker)
+            # replica grid.  Image/float data on an sp topology keeps
+            # plain replica sharding (its dim 3 is not a sequence).
             sharding = (self.topology.seq_batch_sharding(self.mesh),
                         self._batch_sharding)
         return GeoDataLoader(x, y, self.topology, batch_size,

@@ -284,3 +284,20 @@ def test_bsc_sampled_mode_trains_through_allreduce():
     nz = np.asarray(out) != 0
     np.testing.assert_allclose(np.asarray(out)[nz], np.asarray(g)[nz],
                                rtol=1e-6)
+
+
+def test_bsc_sampled_handles_sparse_gradients():
+    """Regression: a >99%-zero gradient (ReLU nets) has a tied zero
+    boundary; the strict threshold must select the real mass, not the
+    first k zeros by index order."""
+    import jax.numpy as jnp
+
+    n = 64 * 1024
+    c = BiSparseCompressor(ratio=0.01, min_sparse_size=1, select="sampled")
+    g = np.zeros(n, np.float32)
+    g[-100:] = 100.0  # all mass at the tail, invisible to naive ties
+    vals, idx, _, v2 = c.compress(jnp.asarray(g), jnp.zeros((n,)),
+                                  jnp.zeros((n,)))
+    sent = float(np.abs(np.asarray(vals)).sum())
+    assert sent == 100 * 100.0, sent  # every nonzero emitted
+    assert np.all(np.asarray(v2) == 0.0)  # nothing starved
