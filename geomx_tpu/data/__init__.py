@@ -5,10 +5,12 @@ from geomx_tpu.data.samplers import SplitSampler, ClassSplitSampler
 from geomx_tpu.data.datasets import load_dataset, DATASETS
 from geomx_tpu.data.loader import GeoDataLoader
 from geomx_tpu.data.recordio import (RecordIOReader, RecordIOWriter,
+                                     recordio_reader, recordio_writer,
                                      pack_labelled, unpack_labelled)
 from geomx_tpu.data.record_iter import ImageRecordIter, PrefetchIter
 
 __all__ = ["SplitSampler", "ClassSplitSampler", "load_dataset", "DATASETS",
            "GeoDataLoader", "RecordIOReader", "RecordIOWriter",
+           "recordio_reader", "recordio_writer",
            "pack_labelled", "unpack_labelled", "ImageRecordIter",
            "PrefetchIter"]

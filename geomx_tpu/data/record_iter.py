@@ -13,7 +13,8 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu.data.recordio import (RecordIOReader, shard_bounds,
+from geomx_tpu.data.recordio import (RecordIOReader, recordio_reader,
+                                     shard_bounds,
                                      unpack_labelled)
 
 
@@ -91,7 +92,7 @@ class ImageRecordIter:
                  part_index: int = 0, num_parts: int = 1,
                  shuffle: bool = True, seed: int = 0,
                  prefetch: int = 2):
-        self.reader = RecordIOReader(path)
+        self.reader = recordio_reader(path)
         n = len(self.reader)  # requires the .idx sidecar
         lo, hi = shard_bounds(n, part_index, num_parts)
         self._indices = np.arange(lo, hi)

@@ -175,3 +175,33 @@ def unpack_labelled(payload: bytes) -> Tuple[float, "np.ndarray"]:
     _, label, h, w, c = _REC.unpack_from(payload, 0)
     img = np.frombuffer(payload, np.uint8, h * w * c, _REC.size)
     return label, img.reshape((h, w, c))
+
+
+# ---- native-preferring factories ------------------------------------------
+# The reference's data plane is C++ (dmlc-core recordio + src/io
+# iterators); when the native runtime is built, packing/reading goes
+# through the C++ implementation (byte-identical format) so per-record
+# work doesn't pay the interpreter.  GEOMX_NATIVE_RECORDIO=0 opts out.
+
+def recordio_writer(path: str, index: bool = True):
+    if os.environ.get("GEOMX_NATIVE_RECORDIO", "1") != "0":
+        try:
+            from geomx_tpu.runtime.native import (NativeRecordIOWriter,
+                                                  native_available)
+            if native_available():
+                return NativeRecordIOWriter(path, index=index)
+        except Exception:
+            pass
+    return RecordIOWriter(path, index=index)
+
+
+def recordio_reader(path: str):
+    if os.environ.get("GEOMX_NATIVE_RECORDIO", "1") != "0":
+        try:
+            from geomx_tpu.runtime.native import (NativeRecordIOReader,
+                                                  native_available)
+            if native_available():
+                return NativeRecordIOReader(path)
+        except Exception:
+            pass
+    return RecordIOReader(path)

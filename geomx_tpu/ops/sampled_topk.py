@@ -47,13 +47,21 @@ def sampled_threshold_select(v: jax.Array, absv: jax.Array, k: int,
     # boundary at the (1 - k/n) quantile of the sample
     pos = int(round(m * (1.0 - k / n)))
     thr = ssorted[min(max(pos, 0), m - 1)]
-    # STRICT comparison: with a tied boundary (the common case being
-    # thr == 0 on sparse/ReLU gradients, where >99% of entries are
-    # exactly 0) an inclusive mask would fill all k slots with the
-    # first k zeros by index order and starve the real mass forever
-    mask = absv > thr
-    mask_i = mask.astype(jnp.int32)
-    rank = jnp.cumsum(mask_i) - mask_i          # exclusive rank among hits
+    # two-tier selection: strictly-above-boundary elements claim slots
+    # FIRST, boundary-tied elements fill whatever remains.  A plain
+    # inclusive mask starves real mass on sparse gradients (thr == 0 ->
+    # the first k zeros win by index order); a plain strict mask starves
+    # constant-magnitude gradients (everything tied at thr -> nothing
+    # ever emitted, and uniform error feedback keeps the tie forever).
+    primary = absv > thr
+    secondary = absv == thr
+    p_i = primary.astype(jnp.int32)
+    s_i = secondary.astype(jnp.int32)
+    p_rank = jnp.cumsum(p_i) - p_i              # exclusive rank among >
+    n_primary = jnp.sum(p_i)
+    s_rank = n_primary + jnp.cumsum(s_i) - s_i  # ties queue after all >
+    rank = jnp.where(primary, p_rank, s_rank)
+    mask = primary | secondary
     keep = mask & (rank < k)
     # scatter kept coordinates into their rank slot; overflow and
     # non-hits pile into the dump slot k (dropped)

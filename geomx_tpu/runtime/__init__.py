@@ -7,8 +7,11 @@ geops_runtime.cpp) behind ctypes, with automatic build-on-first-use and
 pure-Python fallbacks (geomx_tpu.transport) when no toolchain exists.
 """
 
-from geomx_tpu.runtime.native import (NativePriorityQueue, NativeTSEngine,
+from geomx_tpu.runtime.native import (NativePriorityQueue,
+                                      NativeRecordIOReader,
+                                      NativeRecordIOWriter, NativeTSEngine,
                                       load_native, native_available)
 
-__all__ = ["NativePriorityQueue", "NativeTSEngine", "load_native",
+__all__ = ["NativePriorityQueue", "NativeRecordIOReader",
+           "NativeRecordIOWriter", "NativeTSEngine", "load_native",
            "native_available"]

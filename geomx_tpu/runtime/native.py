@@ -96,6 +96,30 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.gx_sgd_mom_update.argtypes = [fp, fp, fp, ctypes.c_int64,
                                           ctypes.c_float, ctypes.c_float,
                                           ctypes.c_float, ctypes.c_float]
+        # recordio
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.gx_recio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.gx_recio_writer_open.restype = ctypes.c_void_p
+        lib.gx_recio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int64, ctypes.c_int64,
+                                       ctypes.c_int]
+        lib.gx_recio_write.restype = ctypes.c_int64
+        lib.gx_recio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.gx_recio_reader_open.argtypes = [ctypes.c_char_p]
+        lib.gx_recio_reader_open.restype = ctypes.c_void_p
+        lib.gx_recio_count.argtypes = [ctypes.c_void_p]
+        lib.gx_recio_count.restype = ctypes.c_int64
+        lib.gx_recio_key.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.gx_recio_key.restype = ctypes.c_int64
+        lib.gx_recio_read_idx.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_char_p, ctypes.c_int64,
+                                          i64p]
+        lib.gx_recio_read_idx.restype = ctypes.c_int64
+        lib.gx_recio_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64, i64p]
+        lib.gx_recio_next.restype = ctypes.c_int64
+        lib.gx_recio_reset.argtypes = [ctypes.c_void_p]
+        lib.gx_recio_reader_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -278,3 +302,109 @@ class NativeSGD:
                                         self.lr, self.momentum, self.wd,
                                         self.clip)
         return w
+
+
+class NativeRecordIOWriter:
+    """C++ recordio writer — byte-identical output to
+    data.recordio.RecordIOWriter (magic/len/crc framing + .idx sidecar)."""
+
+    def __init__(self, path: str, index: bool = True):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self.path = path
+        self._h = lib.gx_recio_writer_open(path.encode(), 1 if index else 0)
+        if not self._h:
+            raise OSError(f"cannot open {path!r} for writing")
+
+    def write(self, payload: bytes, key: Optional[int] = None) -> int:
+        off = self._lib.gx_recio_write(self._h, payload, len(payload),
+                                       0 if key is None else int(key),
+                                       0 if key is None else 1)
+        if off < 0:
+            raise OSError("recordio write failed")
+        return int(off)
+
+    def close(self):
+        if self._h:
+            self._lib.gx_recio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativeRecordIOReader:
+    """C++ recordio reader with the same surface as
+    data.recordio.RecordIOReader (iteration, read_idx, keys,
+    read_shard)."""
+
+    def __init__(self, path: str):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self.path = path
+        self._h = lib.gx_recio_reader_open(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open {path!r}")
+        self._buf_len = 1 << 16
+
+    def _call(self, fn, *args) -> bytes:
+        import ctypes as ct
+        while True:
+            buf = ct.create_string_buffer(self._buf_len)
+            req = ct.c_int64()
+            n = fn(self._h, *args, buf, self._buf_len, ct.byref(req))
+            if n == -3:
+                self._buf_len = int(req.value)
+                continue
+            if n == -1:
+                raise EOFError("end of recordio stream")
+            if n == -4:
+                raise IndexError("record index out of range")
+            if n < 0:
+                raise ValueError("corrupt record (bad magic or crc)")
+            return buf.raw[:n]
+
+    def __iter__(self):
+        self._lib.gx_recio_reset(self._h)
+        while True:
+            try:
+                yield self._call(self._lib.gx_recio_next)
+            except EOFError:
+                return
+
+    def __len__(self) -> int:
+        n = self._lib.gx_recio_count(self._h)
+        if n < 0:
+            raise TypeError("no .idx sidecar; sequential access only")
+        return int(n)
+
+    def read_idx(self, i: int) -> bytes:
+        return self._call(self._lib.gx_recio_read_idx, int(i))
+
+    def keys(self):
+        return [int(self._lib.gx_recio_key(self._h, i))
+                for i in range(len(self))]
+
+    def read_shard(self, part_index: int, num_parts: int):
+        from geomx_tpu.data.recordio import shard_bounds
+        lo, hi = shard_bounds(len(self), part_index, num_parts)
+        for i in range(lo, hi):
+            yield self.read_idx(i)
+
+    def close(self):
+        if self._h:
+            self._lib.gx_recio_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

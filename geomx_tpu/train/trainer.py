@@ -99,15 +99,22 @@ class Trainer:
 
     def make_loader(self, x, y, batch_size: int, split_by_class: bool = False,
                     seed: int = 0, augment: bool = False,
-                    device_cache: bool = False) -> GeoDataLoader:
+                    device_cache: bool = False,
+                    seq_sharded: Optional[bool] = None) -> GeoDataLoader:
+        """``seq_sharded``: shard x's sequence dim over the sp axis
+        (requires an sp topology).  Default: auto — wide-integer
+        [N, L(, feat)] token batches on an sp topology; uint8 data
+        (images) and floats keep plain replica sharding."""
+        dtype = getattr(x, "dtype", None)
+        ndim = getattr(x, "ndim", 0)
+        if seq_sharded is None:
+            seq_sharded = (
+                getattr(self.topology, "sp_degree", 1) > 1
+                and dtype is not None
+                and np.issubdtype(dtype, np.integer)
+                and dtype != np.uint8 and ndim in (2, 3))
         sharding = self._batch_sharding
-        if getattr(self.topology, "sp_degree", 1) > 1 \
-                and np.issubdtype(np.asarray(x).dtype, np.integer) \
-                and np.asarray(x).ndim in (2, 3):
-            # integer token batches [N, L(, feat)]: x's sequence dim
-            # shards over the sp axis, labels stay on the (dc, worker)
-            # replica grid.  Image/float data on an sp topology keeps
-            # plain replica sharding (its dim 3 is not a sequence).
+        if seq_sharded:
             sharding = (self.topology.seq_batch_sharding(self.mesh),
                         self._batch_sharding)
         return GeoDataLoader(x, y, self.topology, batch_size,

@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from geomx_tpu.data.recordio import RecordIOWriter, pack_labelled
+from geomx_tpu.data.recordio import pack_labelled, recordio_writer
 
 
 def from_dataset(name: str, split: str, root: str):
@@ -69,7 +69,7 @@ def main():
     else:
         xs, ys = from_folder(args.image_folder)
 
-    with RecordIOWriter(args.output) as w:
+    with recordio_writer(args.output) as w:
         for img, label in zip(xs, ys):
             w.write(pack_labelled(float(label), img))
     print(f"wrote {len(ys)} records to {args.output} (+ .idx)")
