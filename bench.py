@@ -29,7 +29,7 @@ Env knobs:
                              (default 900)
   GEOMX_BENCH_INIT_ATTEMPTS  fresh-child init attempts (default 3)
   GEOMX_BENCH_TIMEOUT        seconds for measurement after init
-                             (default 3000)
+                             (default 4500)
   GEOMX_BENCH_TTA=0          skip time-to-accuracy (runs by default:
                              real CIFAR10 when present/fetchable under
                              GEOMX_DATA_DIR, else the synthetic proxy)
@@ -441,9 +441,12 @@ def _time_to_accuracy(batch):
     # must still be able to cross the target at the epoch budget's tail)
     spe = max(1, len(data["train_x"]) // (local_b * topo.total_workers))
     peak_lr = 0.1 * max(1.0, (local_b * topo.total_workers) / 512)
+    total_steps = max_epochs * spe
+    # warmup ~2 epochs but never the whole budget (tiny debug budgets)
+    warmup = min(2 * spe, max(1, total_steps // 10))
     sched = optax.schedules.warmup_cosine_decay_schedule(
         init_value=peak_lr / 10, peak_value=peak_lr,
-        warmup_steps=2 * spe, decay_steps=max_epochs * spe,
+        warmup_steps=warmup, decay_steps=max(total_steps, warmup + 1),
         end_value=peak_lr / 20)
     trainer = Trainer(ResNet20(num_classes=10), topo,
                       optax.sgd(sched, momentum=0.9, nesterov=True),
@@ -561,6 +564,16 @@ def child_main():
     except Exception as e:
         _emit({"event": "fit_loop", "error": repr(e)})
 
+    # time-to-accuracy is the north star — runs by DEFAULT (the r3
+    # artifact lacked it because the driver didn't set the env) and
+    # BEFORE the microbench/profile extras, so a measurement-deadline
+    # kill still captures it; GEOMX_BENCH_TTA=0 opts out
+    if os.environ.get("GEOMX_BENCH_TTA", "1") != "0":
+        try:
+            _emit({"event": "tta", **_time_to_accuracy(batch)})
+        except Exception as e:
+            _emit({"event": "tta", "error": repr(e)})
+
     try:
         _emit({"event": "microbench",
                **_microbench_kernels(peak, on_tpu)})
@@ -571,15 +584,6 @@ def child_main():
         _emit({"event": "profile", **_per_op_profile(batch, peak, on_tpu)})
     except Exception as e:
         _emit({"event": "profile", "error": repr(e)})
-
-    # time-to-accuracy is the north star — runs by DEFAULT (the r3
-    # artifact lacked it because the driver didn't set the env);
-    # GEOMX_BENCH_TTA=0 opts out
-    if os.environ.get("GEOMX_BENCH_TTA", "1") != "0":
-        try:
-            _emit({"event": "tta", **_time_to_accuracy(batch)})
-        except Exception as e:
-            _emit({"event": "tta", "error": repr(e)})
 
     _emit({"event": "done"})
 
@@ -668,7 +672,7 @@ def _run_attempt(init_timeout, total_timeout, results):
 
 def parent_main():
     init_timeout = float(os.environ.get("GEOMX_BENCH_INIT_TIMEOUT", "900"))
-    total_timeout = float(os.environ.get("GEOMX_BENCH_TIMEOUT", "3000"))
+    total_timeout = float(os.environ.get("GEOMX_BENCH_TIMEOUT", "4500"))
     attempts = int(os.environ.get("GEOMX_BENCH_INIT_ATTEMPTS", "3"))
 
     results = {"configs": {}, "backend": None, "fit_loop": None,

@@ -105,6 +105,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                        ctypes.c_int]
         lib.gx_recio_write.restype = ctypes.c_int64
         lib.gx_recio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.gx_recio_writer_close.restype = ctypes.c_int
         lib.gx_recio_reader_open.argtypes = [ctypes.c_char_p]
         lib.gx_recio_reader_open.restype = ctypes.c_void_p
         lib.gx_recio_count.argtypes = [ctypes.c_void_p]
@@ -118,6 +119,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.gx_recio_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_int64, i64p]
         lib.gx_recio_next.restype = ctypes.c_int64
+        lib.gx_recio_size.argtypes = [ctypes.c_void_p]
+        lib.gx_recio_size.restype = ctypes.c_int64
+        lib.gx_recio_read_off.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_char_p, ctypes.c_int64,
+                                          i64p, i64p]
+        lib.gx_recio_read_off.restype = ctypes.c_int64
         lib.gx_recio_reset.argtypes = [ctypes.c_void_p]
         lib.gx_recio_reader_close.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -328,8 +335,11 @@ class NativeRecordIOWriter:
 
     def close(self):
         if self._h:
-            self._lib.gx_recio_writer_close(self._h)
-            self._h = None
+            h, self._h = self._h, None
+            if self._lib.gx_recio_writer_close(h) != 0:
+                raise OSError(
+                    f"recordio close failed for {self.path!r} (buffered "
+                    "writes could not be flushed — disk full?)")
 
     def __enter__(self):
         return self
@@ -352,16 +362,20 @@ class NativeRecordIOReader:
         self._h = lib.gx_recio_reader_open(path.encode())
         if not self._h:
             raise OSError(f"cannot open {path!r}")
-        self._buf_len = 1 << 16
+        # one persistent buffer, grown on demand: allocating (and
+        # zero-filling) a fresh max-ever-size buffer per record would
+        # cost more than the interpreter work the native path removes
+        self._buf = ctypes.create_string_buffer(1 << 16)
 
-    def _call(self, fn, *args) -> bytes:
+    def _call(self, fn, *args, consumed=None) -> bytes:
         import ctypes as ct
         while True:
-            buf = ct.create_string_buffer(self._buf_len)
             req = ct.c_int64()
-            n = fn(self._h, *args, buf, self._buf_len, ct.byref(req))
+            extra = () if consumed is None else (ct.byref(consumed),)
+            n = fn(self._h, *args, self._buf, len(self._buf),
+                   ct.byref(req), *extra)
             if n == -3:
-                self._buf_len = int(req.value)
+                self._buf = ct.create_string_buffer(int(req.value))
                 continue
             if n == -1:
                 raise EOFError("end of recordio stream")
@@ -369,15 +383,20 @@ class NativeRecordIOReader:
                 raise IndexError("record index out of range")
             if n < 0:
                 raise ValueError("corrupt record (bad magic or crc)")
-            return buf.raw[:n]
+            return self._buf.raw[:n]
 
     def __iter__(self):
-        self._lib.gx_recio_reset(self._h)
-        while True:
-            try:
-                yield self._call(self._lib.gx_recio_next)
-            except EOFError:
-                return
+        # per-iterator cursor (parity with the Python reader): nested or
+        # concurrent iterators must not corrupt each other's position
+        import ctypes as ct
+        off = 0
+        size = int(self._lib.gx_recio_size(self._h))
+        consumed = ct.c_int64()
+        while off < size:
+            payload = self._call(self._lib.gx_recio_read_off, off,
+                                 consumed=consumed)
+            off += int(consumed.value)
+            yield payload
 
     def __len__(self) -> int:
         n = self._lib.gx_recio_count(self._h)

@@ -347,22 +347,25 @@ void gx_sgd_mom_update(float* w, const float* g, float* mom, int64_t n,
 
 static const uint32_t kGxRecMagic = 0xCED7230Au;
 
-static uint32_t gx_crc32(const uint8_t* data, int64_t len) {
-  // standard reflected CRC-32 (IEEE; identical to zlib.crc32)
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
+struct GxCrcTable {
+  uint32_t t[256];
+  GxCrcTable() {
     for (uint32_t i = 0; i < 256; ++i) {
       uint32_t c = i;
       for (int j = 0; j < 8; ++j)
         c = (c & 1) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
-      table[i] = c;
+      t[i] = c;
     }
-    init = true;
   }
+};
+
+static uint32_t gx_crc32(const uint8_t* data, int64_t len) {
+  // standard reflected CRC-32 (IEEE; identical to zlib.crc32).  C++11
+  // magic-static: the table build is thread-safe on first concurrent use
+  static const GxCrcTable table;
   uint32_t c = 0xFFFFFFFFu;
   for (int64_t i = 0; i < len; ++i)
-    c = table[(c ^ data[i]) & 0xFFu] ^ (c >> 8);
+    c = table.t[(c ^ data[i]) & 0xFFu] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
 
@@ -414,11 +417,22 @@ int64_t gx_recio_write(void* h, const uint8_t* data, int64_t len,
   return off;
 }
 
-void gx_recio_writer_close(void* h) {
+// returns 0 on success, -1 if flushing buffered writes failed (e.g.
+// ENOSPC) — buffered fwrite errors only surface here, and swallowing
+// them would report a truncated file as a successful pack
+int gx_recio_writer_close(void* h) {
   auto* w = static_cast<GxRecWriter*>(h);
-  if (w->f) fclose(w->f);
-  if (w->idx) fclose(w->idx);
+  int rc = 0;
+  if (w->f) {
+    if (fflush(w->f) != 0 || ferror(w->f)) rc = -1;
+    if (fclose(w->f) != 0) rc = -1;
+  }
+  if (w->idx) {
+    if (fflush(w->idx) != 0 || ferror(w->idx)) rc = -1;
+    if (fclose(w->idx) != 0) rc = -1;
+  }
   delete w;
+  return rc;
 }
 
 struct GxRecReader {
@@ -475,6 +489,9 @@ static int64_t gx_recio_read_at(GxRecReader* r, int64_t off, uint8_t* buf,
   if (fread(head, 4, 3, r->f) != 3) return -2;
   if (head[0] != kGxRecMagic) return -2;
   int64_t len = static_cast<int64_t>(head[1]);
+  // a corrupt length field must read as corruption, not as a
+  // buffer-too-small request for gigabytes
+  if (len < 0 || off + 12 + len > r->size) return -2;
   if (len > buf_len) {
     if (required) *required = len;
     return -3;
@@ -508,6 +525,23 @@ int64_t gx_recio_next(void* h, uint8_t* buf, int64_t buf_len,
   int64_t n = gx_recio_read_at(r, r->pos, buf, buf_len, required, &consumed);
   if (n >= 0) r->pos += consumed;
   return n;
+}
+
+int64_t gx_recio_size(void* h) {
+  return static_cast<GxRecReader*>(h)->size;
+}
+
+// stateless sequential read at a caller-tracked offset: each Python
+// iterator keeps its own cursor, so nested/concurrent iterators don't
+// corrupt one another (parity with the pure-Python reader).  Writes the
+// consumed byte span (header + payload + pad) to *consumed.
+int64_t gx_recio_read_off(void* h, int64_t off, uint8_t* buf,
+                          int64_t buf_len, int64_t* required,
+                          int64_t* consumed) {
+  auto* r = static_cast<GxRecReader*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  if (off >= r->size) return -1;
+  return gx_recio_read_at(r, off, buf, buf_len, required, consumed);
 }
 
 void gx_recio_reset(void* h) {

@@ -1,0 +1,82 @@
+// Concurrency stress harness for the native runtime, built under
+// ThreadSanitizer (make tsan && ./geops_stress).
+//
+// The reference ships NO race detection (no TSAN/ASAN targets in its
+// Makefile/CMakeLists; SURVEY.md §5) and leans on its engine's var-based
+// dependency tracking.  Here the native scheduling core is exercised
+// under TSAN as a test: producers and consumers hammer the priority
+// queue through close/destroy, and concurrent askers drive the TSEngine
+// state machine — any data race or lock misuse fails the run.
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* gx_queue_create();
+void gx_queue_destroy(void* q);
+int gx_queue_push(void* q, const uint8_t* data, int64_t len, int64_t prio);
+int64_t gx_queue_pop(void* q, uint8_t* buf, int64_t buf_len,
+                     int64_t timeout_ms, int64_t* prio, int64_t* req);
+int64_t gx_queue_size(void* q);
+void gx_queue_close(void* q);
+
+void* gx_ts_create(int n, double greed, uint64_t seed);
+void gx_ts_destroy(void* p);
+void gx_ts_report(void* p, int s, int r, double thr, int64_t version);
+int gx_ts_ask(void* p, int sender, int64_t version);
+int gx_ts_ask1_key(void* p, int node, const char* key, int num, int* out);
+}
+
+int main() {
+  // --- queue: 4 producers x 4 consumers x 20k msgs through a close ---
+  void* q = gx_queue_create();
+  std::vector<std::thread> threads;
+  for (int p = 0; p < 4; ++p) {
+    threads.emplace_back([q, p] {
+      uint8_t payload[64];
+      std::memset(payload, p, sizeof(payload));
+      for (int i = 0; i < 20000; ++i)
+        if (gx_queue_push(q, payload, sizeof(payload), i % 7) != 0) return;
+    });
+  }
+  for (int c = 0; c < 4; ++c) {
+    threads.emplace_back([q] {
+      uint8_t buf[256];
+      int64_t prio, req;
+      while (true) {
+        int64_t n = gx_queue_pop(q, buf, sizeof(buf), 50, &prio, &req);
+        if (n == -1) return;  // closed and drained
+        if (n == -2) continue;
+      }
+    });
+  }
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  gx_queue_close(q);
+  for (auto& t : threads) t.join();
+  gx_queue_destroy(q);
+
+  // --- TSEngine: concurrent reports + asks + per-key ASK1 ---
+  void* ts = gx_ts_create(9, 0.9, 42);
+  threads.clear();
+  for (int w = 1; w < 9; ++w) {
+    threads.emplace_back([ts, w] {
+      int out[2];
+      for (int64_t v = 1; v <= 500; ++v) {
+        int r = gx_ts_ask(ts, 0, v);
+        if (r >= 0) gx_ts_report(ts, 0, r, 1.0 + w, v);
+        std::string key = "k" + std::to_string(v % 3);
+        gx_ts_ask1_key(ts, w, key.c_str(), 8, out);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  gx_ts_destroy(ts);
+
+  std::printf("stress: OK\n");
+  return 0;
+}
