@@ -116,16 +116,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                           ctypes.c_char_p, ctypes.c_int64,
                                           i64p]
         lib.gx_recio_read_idx.restype = ctypes.c_int64
-        lib.gx_recio_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                      ctypes.c_int64, i64p]
-        lib.gx_recio_next.restype = ctypes.c_int64
         lib.gx_recio_size.argtypes = [ctypes.c_void_p]
         lib.gx_recio_size.restype = ctypes.c_int64
         lib.gx_recio_read_off.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                           ctypes.c_char_p, ctypes.c_int64,
                                           i64p, i64p]
         lib.gx_recio_read_off.restype = ctypes.c_int64
-        lib.gx_recio_reset.argtypes = [ctypes.c_void_p]
         lib.gx_recio_reader_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
@@ -362,20 +358,22 @@ class NativeRecordIOReader:
         self._h = lib.gx_recio_reader_open(path.encode())
         if not self._h:
             raise OSError(f"cannot open {path!r}")
-        # one persistent buffer, grown on demand: allocating (and
-        # zero-filling) a fresh max-ever-size buffer per record would
-        # cost more than the interpreter work the native path removes
-        self._buf = ctypes.create_string_buffer(1 << 16)
+        # per-READER buffer for indexed reads, reused across calls under
+        # a Python-side lock (the C mutex only guards the fill; the
+        # copy-out must not race another call's fill).  Iterators own
+        # their OWN buffer+cursor, so concurrent iteration is safe.
+        self._buf = [ctypes.create_string_buffer(1 << 16)]
+        self._rd_lock = threading.Lock()
 
-    def _call(self, fn, *args, consumed=None) -> bytes:
+    def _call(self, fn, *args, bufholder, consumed=None) -> bytes:
         import ctypes as ct
         while True:
             req = ct.c_int64()
             extra = () if consumed is None else (ct.byref(consumed),)
-            n = fn(self._h, *args, self._buf, len(self._buf),
-                   ct.byref(req), *extra)
+            buf = bufholder[0]
+            n = fn(self._h, *args, buf, len(buf), ct.byref(req), *extra)
             if n == -3:
-                self._buf = ct.create_string_buffer(int(req.value))
+                bufholder[0] = ct.create_string_buffer(int(req.value))
                 continue
             if n == -1:
                 raise EOFError("end of recordio stream")
@@ -383,18 +381,21 @@ class NativeRecordIOReader:
                 raise IndexError("record index out of range")
             if n < 0:
                 raise ValueError("corrupt record (bad magic or crc)")
-            return self._buf.raw[:n]
+            # copy exactly n bytes (`.raw[:n]` would materialize the
+            # whole — possibly once-grown-huge — buffer every record)
+            return ct.string_at(buf, n)
 
     def __iter__(self):
-        # per-iterator cursor (parity with the Python reader): nested or
-        # concurrent iterators must not corrupt each other's position
+        # per-iterator cursor AND buffer (parity with the Python
+        # reader): nested or concurrent iterators share nothing mutable
         import ctypes as ct
         off = 0
         size = int(self._lib.gx_recio_size(self._h))
         consumed = ct.c_int64()
+        bufholder = [ct.create_string_buffer(1 << 16)]
         while off < size:
             payload = self._call(self._lib.gx_recio_read_off, off,
-                                 consumed=consumed)
+                                 bufholder=bufholder, consumed=consumed)
             off += int(consumed.value)
             yield payload
 
@@ -405,7 +406,9 @@ class NativeRecordIOReader:
         return int(n)
 
     def read_idx(self, i: int) -> bytes:
-        return self._call(self._lib.gx_recio_read_idx, int(i))
+        with self._rd_lock:
+            return self._call(self._lib.gx_recio_read_idx, int(i),
+                              bufholder=self._buf)
 
     def keys(self):
         return [int(self._lib.gx_recio_key(self._h, i))

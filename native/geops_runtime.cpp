@@ -439,7 +439,6 @@ struct GxRecReader {
   FILE* f = nullptr;
   std::vector<std::pair<long long, long long>> idx;  // (key, offset)
   bool has_idx = false;
-  int64_t pos = 0;   // sequential cursor (byte offset)
   int64_t size = 0;  // file size
   std::mutex mu;
 };
@@ -515,18 +514,6 @@ int64_t gx_recio_read_idx(void* h, int64_t i, uint8_t* buf, int64_t buf_len,
                           buf_len, required, nullptr);
 }
 
-// sequential: next record from the cursor; -1 at EOF
-int64_t gx_recio_next(void* h, uint8_t* buf, int64_t buf_len,
-                      int64_t* required) {
-  auto* r = static_cast<GxRecReader*>(h);
-  std::lock_guard<std::mutex> lk(r->mu);
-  if (r->pos >= r->size) return -1;
-  int64_t consumed = 0;
-  int64_t n = gx_recio_read_at(r, r->pos, buf, buf_len, required, &consumed);
-  if (n >= 0) r->pos += consumed;
-  return n;
-}
-
 int64_t gx_recio_size(void* h) {
   return static_cast<GxRecReader*>(h)->size;
 }
@@ -542,12 +529,6 @@ int64_t gx_recio_read_off(void* h, int64_t off, uint8_t* buf,
   std::lock_guard<std::mutex> lk(r->mu);
   if (off >= r->size) return -1;
   return gx_recio_read_at(r, off, buf, buf_len, required, consumed);
-}
-
-void gx_recio_reset(void* h) {
-  auto* r = static_cast<GxRecReader*>(h);
-  std::lock_guard<std::mutex> lk(r->mu);
-  r->pos = 0;
 }
 
 void gx_recio_reader_close(void* h) {
