@@ -156,15 +156,23 @@ class GeoPSClient:
             # advertise the address PEERS dial (ADVICE r3 #5): follow the
             # listener's bind — a loopback-bound listener must advertise
             # loopback (peers on this host), a wildcard-bound one (the
-            # launcher's multi-host setting) advertises this party's
-            # reachable host, and a concrete bind address advertises
-            # itself.  GEOMX_RELAY_HOST overrides.
+            # launcher's multi-host setting) advertises THIS PROCESS's
+            # reachable address (the local end of the server connection
+            # — NOT GEOMX_PS_HOST, which is the party SERVER's host and
+            # wrong for a worker on a different machine), and a concrete
+            # bind address advertises itself.  GEOMX_RELAY_HOST
+            # overrides.
             adv = os.environ.get("GEOMX_RELAY_HOST")
             if not adv:
                 if bind_host in ("127.0.0.1", "localhost", "::1"):
                     adv = "127.0.0.1"
                 elif bind_host in ("0.0.0.0", "::"):
-                    adv = os.environ.get("GEOMX_PS_HOST") or "127.0.0.1"
+                    try:
+                        adv = self._sock.getsockname()[0]
+                    except OSError:
+                        adv = "127.0.0.1"
+                    if adv in ("0.0.0.0", "::", ""):
+                        adv = "127.0.0.1"
                 else:
                     adv = bind_host
             self._request(Msg(MsgType.COMMAND,

@@ -976,18 +976,33 @@ class GeoPSServer:
                 # both merge; rolled back below if processing fails so a
                 # retransmit can still succeed
                 self._seen_pushes[sig] = True
-                while len(self._seen_pushes) > 65536:
-                    k0 = next(iter(self._seen_pushes))
-                    if self._seen_pushes[k0] == "parked":
-                        break  # never evict an in-flight signature
-                    self._seen_pushes.pop(k0)
+                if len(self._seen_pushes) > 65536:
+                    # evict oldest COMPLETED signatures; parked (in-
+                    # flight async relay) entries are skipped rather
+                    # than breaking the sweep — a parked head must not
+                    # disable the cap while pushes keep arriving
+                    for k0 in list(itertools.islice(
+                            iter(self._seen_pushes), 1024)):
+                        if len(self._seen_pushes) <= 65536:
+                            break
+                        if self._seen_pushes[k0] == "parked":
+                            continue
+                        del self._seen_pushes[k0]
             if msg.meta.get("chunk") is not None:
+                if msg.meta.get("num_required") is not None:
+                    # best-effort DGT: a NEWER round's first chunk must
+                    # not discard the previous round wholesale — its
+                    # reliable top-k blocks were ACKed and their merge is
+                    # owed.  Finalize the outstanding round (missing
+                    # deferred blocks as zeros) BEFORE the accumulator
+                    # resets to the new generation.
+                    self._dgt_supersede_locked(msg)
                 full = self._p3_accumulate(msg, grad)
                 if full is None:   # more chunks outstanding
                     if msg.meta.get("num_required") is not None:
-                        # best-effort DGT: once the reliable (top-k)
-                        # blocks are all in, start the deadline after
-                        # which missing deferred blocks count as zeros
+                        # once the reliable (top-k) blocks are all in,
+                        # start the deadline after which missing
+                        # deferred blocks count as zeros
                         self._dgt_track(msg)
                     self._reply(conn, msg, Msg(MsgType.ACK, key=key))
                     return
@@ -1047,31 +1062,46 @@ class GeoPSServer:
         if st is not None and st["timer"] is not None:
             st["timer"].cancel()
 
+    def _dgt_supersede_locked(self, msg: Msg):
+        """A chunk of a NEWER round arrived while an older round is still
+        pending: force-finalize the older round now.  Caller holds
+        self._lock."""
+        pk = (msg.sender, msg.key)
+        rnd = int(msg.meta.get("round", 0))
+        st = self._dgt_pending.get(pk)
+        if st is not None and rnd > st["round"]:
+            if st["timer"] is not None:
+                st["timer"].cancel()
+            self._dgt_finalize_locked(pk, st["round"])
+
     def _dgt_finalize(self, pk, rnd: int):
         """Deadline fired: merge the push with its missing deferred
         blocks as zeros.  No-op if the set completed in the meantime."""
         with self._lock:
-            st = self._dgt_pending.get(pk)
-            if st is None or st["round"] != rnd:
-                return
-            del self._dgt_pending[pk]
-            part = self._p3_partial.get(pk)
-            if part is None or part.gen != rnd:
-                # the assembly moved on (a newer round's chunks arrived,
-                # or the set completed and merged): never force-merge a
-                # buffer from a different round than this deadline's
-                return
-            self._p3_partial.pop(pk, None)
-            grad = part.force()
-            if grad is None:
-                return
-            proto = Msg(MsgType.PUSH, key=pk[1],
-                        meta={"round": rnd,
-                              "num_merge": st["num_merge"]})
-            proto.sender = pk[0]
-            # conn=None: every arrived chunk was already ACKed (the
-            # client doesn't wait on deferred blocks); _reply no-ops
-            self._push_locked(None, proto, pk[1], grad)
+            self._dgt_finalize_locked(pk, rnd)
+
+    def _dgt_finalize_locked(self, pk, rnd: int):
+        st = self._dgt_pending.get(pk)
+        if st is None or st["round"] != rnd:
+            return
+        del self._dgt_pending[pk]
+        part = self._p3_partial.get(pk)
+        if part is None or part.gen != rnd:
+            # the assembly moved on (the set completed and merged, or
+            # was never fed): never force-merge a buffer from a
+            # different round than this finalize's
+            return
+        self._p3_partial.pop(pk, None)
+        grad = part.force()
+        if grad is None:
+            return
+        proto = Msg(MsgType.PUSH, key=pk[1],
+                    meta={"round": rnd,
+                          "num_merge": st["num_merge"]})
+        proto.sender = pk[0]
+        # conn=None: every arrived chunk was already ACKed (the
+        # client doesn't wait on deferred blocks); _reply no-ops
+        self._push_locked(None, proto, pk[1], grad)
 
     def _p3_accumulate(self, msg: Msg, piece: np.ndarray):
         """Collect one P3 chunk; returns the reassembled tensor when the

@@ -65,7 +65,8 @@ def make_loss_fn(apply_fn: Callable, mutable_keys=("batch_stats",)):
 
 def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                      sync: SyncAlgorithm, topology: HiPSTopology, mesh: Mesh,
-                     donate: bool = True, config=None):
+                     donate: bool = True, config=None,
+                     sp_model: bool = False):
     """Build `train_step(state, x, y) -> (state, metrics)`.
 
     - state leaves carry [num_parties, workers_per_party] replica axes;
@@ -77,10 +78,19 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     optimizer -> all_gather over the worker axis; the dc-tier collective
     moves only the shard).  Requires FSA and a state initialized with
     shard-shaped optimizer/compressor leaves (Trainer handles this).
+
+    ``sp_model``: the model runs in-graph collectives over the sp axis
+    (Trainer sets this from the model's ``sp_mode``).  Sequence
+    parallelism is a MODEL property, not just a mesh one: only an
+    sp-aware model may receive sequence-sharded inputs and needs its
+    shard-path grads SUMMED over sp.  A plain model on an sp mesh keeps
+    replicated inputs and computes identical grads on every sp device —
+    redundant but correct (no reduction needed), never silently sliced
+    images.
     """
     sync.bind_topology(topology)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-    sp = getattr(topology, "sp_degree", 1)
+    sp = getattr(topology, "sp_degree", 1) if sp_model else 1
 
     mgps = None
     if config is not None and getattr(config, "multi_gps", False):

@@ -48,9 +48,19 @@ class Trainer:
         self.mesh = mesh if mesh is not None else topology.build_mesh()
         self.tx = optimizer
         self.loss_fn = make_loss_fn(model.apply)
+        sp_model = getattr(model, "sp_mode", None) is not None
+        if getattr(topology, "sp_degree", 1) > 1 and not sp_model:
+            import warnings
+            warnings.warn(
+                f"topology has sp_degree={topology.sp_degree} but the "
+                "model declares no sp_mode: inputs stay replicated over "
+                "the sp axis and every sp device computes the same thing "
+                "— correct but wasted chips. Use an sp-aware model (e.g. "
+                "SeqClassifier(sp_mode='ring')) or sp_degree=1.",
+                RuntimeWarning, stacklevel=2)
         self.train_step = build_train_step(
             self.loss_fn, self.tx, self.sync, topology, self.mesh,
-            donate=donate, config=self.config)
+            donate=donate, config=self.config, sp_model=sp_model)
         self._mgps = None
         if self.config.multi_gps:
             from geomx_tpu.parallel.multigps import MultiGPSPlan
@@ -110,6 +120,7 @@ class Trainer:
         if seq_sharded is None:
             seq_sharded = (
                 getattr(self.topology, "sp_degree", 1) > 1
+                and getattr(self.model, "sp_mode", None) is not None
                 and dtype is not None
                 and np.issubdtype(dtype, np.integer)
                 and dtype != np.uint8 and ndim in (2, 3))

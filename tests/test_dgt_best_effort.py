@@ -97,3 +97,36 @@ def test_best_effort_training_converges_without_resend(monkeypatch):
         f"{sent_reliable_equivalent} blocks — expected loss")
     c.stop_server()
     c.close()
+
+
+def test_back_to_back_rounds_do_not_lose_reliable_blocks(monkeypatch):
+    """Regression (r4 review): a newer round's chunks must FINALIZE the
+    outstanding round — its reliable top-k blocks were ACKed — not
+    discard it.  Two rounds pushed faster than the deadline must both
+    merge."""
+    monkeypatch.setenv("GEOMX_DROP_MSG", "60")
+    monkeypatch.setenv("GEOMX_DGT_DEADLINE_MS", "2000")  # >> push gap
+    server = GeoPSServer(num_workers=1, mode="sync",
+                         accumulate=True).start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    be, nb = 128, 8
+    n = be * nb
+    c.init("w", np.zeros(n, np.float32))
+    g1 = np.ones(n, np.float32)
+    g2 = np.full(n, 10.0, np.float32)
+    c.push_dgt("w", g1, k=0.5, block_elems=be, best_effort=True)
+    c.push_dgt("w", g2, k=0.5, block_elems=be, best_effort=True)
+    out = c.pull("w", timeout=30.0, meta={"min_round": 2})
+    # both rounds merged (accumulate mode): every round-1 top-k block
+    # contributes 1.0 and every round-2 top-k block contributes 10.0;
+    # with uniform magnitudes the top-k pick is tie-broken but the sum
+    # of delivered mass must include BOTH rounds' reliable halves
+    with server._lock:
+        st = server._store["w"]
+        assert st.round == 2, st.round
+        assert st.pushed.get(0) == 2, st.pushed
+    # round 1's reliable half survived: at least one block carries the
+    # 1.0 contribution (alone or summed with round 2's 10.0)
+    assert (out >= 1.0).any() and (out % 10 == 1).any(), out[:8]
+    c.stop_server()
+    c.close()

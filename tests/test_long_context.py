@@ -82,11 +82,12 @@ def test_example_converges():
     signal) on the virtual mesh."""
     import os
 
-    os.environ["GEOMX_EPOCHS"] = "3"
-    os.environ["GEOMX_SEQ_LEN"] = "96"
-    os.environ["GEOMX_NUM_PARTIES"] = "1"
-    os.environ["GEOMX_WORKERS_PER_PARTY"] = "2"
-    os.environ["GEOMX_SP_DEGREE"] = "2"
+    keys = ("GEOMX_EPOCHS", "GEOMX_SEQ_LEN", "GEOMX_NUM_PARTIES",
+            "GEOMX_WORKERS_PER_PARTY", "GEOMX_SP_DEGREE")
+    saved = {k: os.environ.get(k) for k in keys}
+    os.environ.update(GEOMX_EPOCHS="3", GEOMX_SEQ_LEN="96",
+                      GEOMX_NUM_PARTIES="1", GEOMX_WORKERS_PER_PARTY="2",
+                      GEOMX_SP_DEGREE="2")
     try:
         import importlib.util
         spec = importlib.util.spec_from_file_location(
@@ -97,7 +98,9 @@ def test_example_converges():
         spec.loader.exec_module(mod)
         acc = mod.main("ulysses")
     finally:
-        for k in ("GEOMX_EPOCHS", "GEOMX_SEQ_LEN", "GEOMX_NUM_PARTIES",
-                  "GEOMX_WORKERS_PER_PARTY", "GEOMX_SP_DEGREE"):
-            os.environ.pop(k, None)
+        for k, v in saved.items():  # restore the caller's environment
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     assert acc > 0.5, f"needle task should be learnable, got {acc}"
