@@ -585,6 +585,25 @@ def child_main():
     except Exception as e:
         _emit({"event": "profile", "error": repr(e)})
 
+    # batch scaling: samples/sec/chip vs per-chip batch for the vanilla
+    # config (how far MXU amortization takes the headline); lowest
+    # priority — last, so a deadline kill costs only this
+    if on_tpu and os.environ.get("GEOMX_BENCH_SWEEP", "1") != "0":
+        sweep = {}
+        for b in (1024, 2048, 4096, 8192):
+            try:
+                r = _measure_config("vanilla_local",
+                                    {"sync_mode": "fsa",
+                                     "compression": "none"}, 1, b,
+                                    max(20, iters // 2), peak)
+                sweep[str(b)] = {
+                    "samples_per_sec_per_chip":
+                        r["samples_per_sec_per_chip"],
+                    "step_time_ms": r["step_time_ms"], "mfu": r["mfu"]}
+            except Exception as e:
+                sweep[str(b)] = {"error": repr(e)}
+        _emit({"event": "batch_sweep", **sweep})
+
     _emit({"event": "done"})
 
 
@@ -656,6 +675,8 @@ def _run_attempt(init_timeout, total_timeout, results):
             results["microbench"] = ev
         elif kind == "profile":
             results["profile"] = ev
+        elif kind == "batch_sweep":
+            results["batch_sweep"] = ev
         elif kind == "tta":
             results["tta"] = ev
         elif kind == "done":
@@ -676,7 +697,8 @@ def parent_main():
     attempts = int(os.environ.get("GEOMX_BENCH_INIT_ATTEMPTS", "3"))
 
     results = {"configs": {}, "backend": None, "fit_loop": None,
-               "microbench": None, "profile": None, "tta": None}
+               "microbench": None, "profile": None, "batch_sweep": None,
+               "tta": None}
     attempt_log = []
     error = None
     for i in range(max(1, attempts)):
@@ -710,6 +732,7 @@ def parent_main():
         "fit_loop": results["fit_loop"],
         "microbench": microbench,
         "profile": results["profile"],
+        "batch_sweep": results["batch_sweep"],
     }
     if tta is not None:
         out["time_to_accuracy"] = tta

@@ -33,8 +33,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu.service.protocol import (Msg, MsgType, connect_retry, env_int,
-                                        recv_frame, send_frame)
+from geomx_tpu.service.protocol import (Msg, MsgType, _log_msg,
+                                        _verbose_level, connect_retry,
+                                        env_int, recv_frame, send_frame,
+                                        wire_stats)
 
 
 class _RelayConnectError(OSError):
@@ -170,9 +172,14 @@ class GeoPSClient:
                     try:
                         adv = self._sock.getsockname()[0]
                     except OSError:
-                        adv = "127.0.0.1"
-                    if adv in ("0.0.0.0", "::", ""):
-                        adv = "127.0.0.1"
+                        adv = ""
+                    if adv in ("0.0.0.0", "::", "", "127.0.0.1", "::1"):
+                        # the server was dialed over loopback, which says
+                        # nothing about THIS host's reachable address —
+                        # fall back to the launcher-set party host, then
+                        # loopback (single-host deployments)
+                        adv = (os.environ.get("GEOMX_PS_HOST")
+                               or "127.0.0.1")
                 else:
                     adv = bind_host
             self._request(Msg(MsgType.COMMAND,
@@ -208,6 +215,7 @@ class GeoPSClient:
                         len(frame).to_bytes(4, "little") + frame)
                 except OSError:
                     return
+            wire_stats.add_sent(len(frame) + 4)
 
     def _recv_loop(self):
         while not self._closed:
@@ -298,7 +306,10 @@ class GeoPSClient:
         msg.sender = self.sender_id
         msg.meta["rid"] = rid
         if fire_and_forget:
-            self._sendq.push(msg.encode(), priority)
+            frame = msg.encode()
+            if _verbose_level() >= 2:  # data-path sends log at ENQUEUE
+                _log_msg("ENQ ", msg, len(frame))
+            self._sendq.push(frame, priority)
             return rid
         p = _Pending()
         # only data messages are retransmitted: PUSH is deduped server-side
@@ -311,6 +322,11 @@ class GeoPSClient:
             # enrolls it in the server's replay-dedup signature set
             msg.meta["resend"] = True
         frame = msg.encode()
+        if _verbose_level() >= 2:
+            # the send loop moves opaque pre-encoded frames, so the
+            # data path logs at ENQUEUE time (same wire order: the
+            # priority queue is the only reordering stage)
+            _log_msg("ENQ ", msg, len(frame))
         if resendable:
             p.frame, p.priority = frame, priority
         with self._plock:
@@ -847,6 +863,15 @@ class GeoPSClient:
         reply = self._request(Msg(MsgType.COMMAND,
                                   meta={"cmd": "profiler_dump"}))
         return reply.meta["path"]
+
+    def wire_stats(self) -> dict:
+        """The SERVER process's sent/received byte+message counters (the
+        reference Van's send_bytes_/recv_bytes_, van.h:182-183).  This
+        process's own counters are
+        ``geomx_tpu.service.protocol.wire_stats.snapshot()``."""
+        reply = self._request(Msg(MsgType.COMMAND,
+                                  meta={"cmd": "wire_stats"}))
+        return dict(reply.meta["stats"])
 
     def num_dead_nodes(self, timeout: Optional[float] = None) -> int:
         reply = self._request(Msg(MsgType.COMMAND,

@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import enum
 import io
+import os
 import pickle
 import socket
 import struct
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
@@ -179,9 +181,76 @@ def connect_retry(addr, total_timeout_s: float = 30.0,
             time.sleep(interval_s)
 
 
+class WireStats:
+    """Process-wide sent/received byte and message counters — the
+    analogue of ps-lite's Van counters (van.h:182-183, send_bytes_/
+    recv_bytes_), surfaced per process because one process is one node
+    role in the launch model."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.msgs_sent = 0
+        self.msgs_received = 0
+
+    def add_sent(self, n: int):
+        with self._lock:
+            self.bytes_sent += n
+            self.msgs_sent += 1
+
+    def add_received(self, n: int):
+        with self._lock:
+            self.bytes_received += n
+            self.msgs_received += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"bytes_sent": self.bytes_sent,
+                    "bytes_received": self.bytes_received,
+                    "msgs_sent": self.msgs_sent,
+                    "msgs_received": self.msgs_received}
+
+
+wire_stats = WireStats()
+
+
+_verbose_cache: Optional[int] = None
+
+
+def _verbose_level() -> int:
+    # cached: two env lookups per frame on the hot path add up; tests
+    # (and runtime reconfiguration) call reset_verbose_cache()
+    global _verbose_cache
+    if _verbose_cache is None:
+        try:
+            _verbose_cache = int(os.environ.get("GEOMX_PS_VERBOSE")
+                                 or os.environ.get("PS_VERBOSE") or "0")
+        except ValueError:
+            _verbose_cache = 0
+    return _verbose_cache
+
+
+def reset_verbose_cache() -> None:
+    global _verbose_cache
+    _verbose_cache = None
+
+
+def _log_msg(direction: str, msg: Msg, nbytes: int) -> None:
+    """PS_VERBOSE>=2: log every wire message (the reference's per-message
+    Van logging, postoffice.h:237 / van.cc DBG)."""
+    import sys
+    print(f"[geomx-wire] {direction} {msg.type.name} key={msg.key!r} "
+          f"sender={msg.sender} rid={msg.meta.get('rid')} "
+          f"bytes={nbytes}", file=sys.stderr, flush=True)
+
+
 def send_frame(sock: socket.socket, msg: Msg) -> None:
     data = msg.encode()
     sock.sendall(_LEN.pack(len(data)) + data)
+    wire_stats.add_sent(len(data) + 4)
+    if _verbose_level() >= 2:
+        _log_msg("SEND", msg, len(data))
 
 
 def recv_frame(sock: socket.socket) -> Optional[Msg]:
@@ -192,7 +261,11 @@ def recv_frame(sock: socket.socket) -> Optional[Msg]:
     data = _recv_exact(sock, n)
     if data is None:
         return None
-    return Msg.decode(data)
+    msg = Msg.decode(data)
+    wire_stats.add_received(n + 4)
+    if _verbose_level() >= 2:
+        _log_msg("RECV", msg, n)
+    return msg
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -32,8 +32,10 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomx_tpu.service.protocol import (Msg, MsgType, env_int,
-                                        recv_frame, send_frame, should_drop)
+from geomx_tpu.service.protocol import (Msg, MsgType, _log_msg,
+                                        _verbose_level, env_int,
+                                        recv_frame, send_frame,
+                                        should_drop, wire_stats)
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
 
 
@@ -609,6 +611,13 @@ class GeoPSServer:
                 MsgType.ACK,
                 meta={"dead": self.heartbeats.dead_nodes(
                     msg.meta.get("timeout"))}))
+            return
+        elif cmd == "wire_stats":
+            # this server process's Van-style byte/message counters
+            # (reference van.h:182-183 send_bytes_/recv_bytes_)
+            self._reply(conn, msg, Msg(MsgType.ACK,
+                                       meta={"stats":
+                                             wire_stats.snapshot()}))
             return
         elif cmd == "pause_pull_stream":
             # test/demo hook (mirror of the client's pause_sending): hold
@@ -1490,8 +1499,11 @@ class GeoPSServer:
                       array=flat[i * ce:(i + 1) * ce])
             if rid is not None:
                 rep.meta["rid"] = rid
+            frame = rep.encode()
+            if _verbose_level() >= 2:
+                _log_msg("ENQ ", rep, len(frame))
             try:
-                q.push(rep.encode(), prio)
+                q.push(frame, prio)
             except RuntimeError as e:
                 # queue closed under us (connection torn down): surface
                 # as the connection error it is, which every reply site
@@ -1531,6 +1543,7 @@ class GeoPSServer:
                                 conn.sendall(
                                     len(frame).to_bytes(4, "little")
                                     + frame)
+                                wire_stats.add_sent(len(frame) + 4)
                             except OSError:
                                 # dead socket: drop our queue entry (only
                                 # if still ours — the serve thread may
