@@ -585,11 +585,16 @@ def child_main():
     except Exception as e:
         _emit({"event": "profile", "error": repr(e)})
 
-    # batch scaling: samples/sec/chip vs per-chip batch for the vanilla
-    # config (how far MXU amortization takes the headline); lowest
-    # priority — last, so a deadline kill costs only this
+    # batch scaling for the vanilla config (how far MXU amortization
+    # takes the headline); keys are GLOBAL batch — _measure_config
+    # splits across devices, so per-chip batch = key / n_devices (equal
+    # on the 1-chip bench).  Lowest priority — last, so a deadline kill
+    # costs only this.
     if on_tpu and os.environ.get("GEOMX_BENCH_SWEEP", "1") != "0":
-        sweep = {}
+        import jax
+        n_dev = jax.device_count()
+        sweep = {"note": "keys are GLOBAL batch; per_chip_batch in each "
+                         "entry is what one chip actually runs"}
         for b in (1024, 2048, 4096, 8192):
             try:
                 r = _measure_config("vanilla_local",
@@ -597,6 +602,7 @@ def child_main():
                                      "compression": "none"}, 1, b,
                                     max(20, iters // 2), peak)
                 sweep[str(b)] = {
+                    "per_chip_batch": b // max(1, n_dev),
                     "samples_per_sec_per_chip":
                         r["samples_per_sec_per_chip"],
                     "step_time_ms": r["step_time_ms"], "mfu": r["mfu"]}

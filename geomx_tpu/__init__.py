@@ -16,6 +16,11 @@ re-built TPU-first:
 - TSEngine adaptive communication scheduling (``transport.tsengine``)
 - MultiGPS parameter sharding (``parallel.multigps``)
 
+Beyond the reference's scope: long-context sequence parallelism — ring
+attention (``parallel.ring_attention``) and Ulysses all-to-all
+(``parallel.ulysses``) over a third "sp" mesh axis
+(``HiPSTopology(sp_degree=n)``), first-class through the Trainer.
+
 Synchronization algorithms: FSA (fully-synchronous, default), MixedSync
 (async global tier with optional DCASGD delay compensation), and HFA
 (hierarchical frequency aggregation).
@@ -25,13 +30,15 @@ Reference layer map and parity inventory: see SURVEY.md at the repo root.
 
 __version__ = "0.1.0"
 
-from geomx_tpu.topology import HiPSTopology, DC_AXIS, WORKER_AXIS
+from geomx_tpu.topology import (HiPSTopology, DC_AXIS, SP_AXIS,
+                                WORKER_AXIS)
 from geomx_tpu.config import GeoConfig
 
 __all__ = [
     "HiPSTopology",
     "GeoConfig",
     "DC_AXIS",
+    "SP_AXIS",
     "WORKER_AXIS",
     "__version__",
 ]

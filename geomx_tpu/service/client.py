@@ -156,14 +156,16 @@ class GeoPSClient:
             threading.Thread(target=self._ts_dispatch_loop,
                              daemon=True).start()
             # advertise the address PEERS dial (ADVICE r3 #5): follow the
-            # listener's bind — a loopback-bound listener must advertise
-            # loopback (peers on this host), a wildcard-bound one (the
+            # listener's bind — a loopback-bound listener advertises
+            # loopback (peers on this host); a wildcard-bound one (the
             # launcher's multi-host setting) advertises THIS PROCESS's
-            # reachable address (the local end of the server connection
-            # — NOT GEOMX_PS_HOST, which is the party SERVER's host and
-            # wrong for a worker on a different machine), and a concrete
-            # bind address advertises itself.  GEOMX_RELAY_HOST
-            # overrides.
+            # reachable address, taken from the local end of the server
+            # connection.  When that, too, is loopback (server co-located
+            # or reached through a tunnel) nothing on this host can name
+            # our reachable address, so the chain falls back to the
+            # launcher-set party host — right when workers share the
+            # server's machine, wrong across machines: multi-host
+            # tunneled workers must set GEOMX_RELAY_HOST explicitly.
             adv = os.environ.get("GEOMX_RELAY_HOST")
             if not adv:
                 if bind_host in ("127.0.0.1", "localhost", "::1"):

@@ -261,8 +261,12 @@ def recv_frame(sock: socket.socket) -> Optional[Msg]:
     data = _recv_exact(sock, n)
     if data is None:
         return None
-    msg = Msg.decode(data)
+    # count BEFORE decode: a frame rejected by the header unpickler was
+    # still read off the wire, and the sent/received reconciliation the
+    # counters exist for must not show a phantom deficit during exactly
+    # the malformed-frame events being diagnosed
     wire_stats.add_received(n + 4)
+    msg = Msg.decode(data)
     if _verbose_level() >= 2:
         _log_msg("RECV", msg, n)
     return msg

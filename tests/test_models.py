@@ -46,3 +46,35 @@ def test_gradients_flow():
 def test_unknown_model_raises():
     with pytest.raises(ValueError):
         get_model("vgg99")
+
+
+def test_resnet20_space_to_depth_variant_trains():
+    """The flag-gated TPU stem experiment (bench config vanilla_s2d)
+    trains: the 2x2 space-to-depth stem halves every stage's resolution
+    but keeps a working ResNet-20 sibling."""
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.models import get_model
+    from geomx_tpu.sync import FSA
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    model = get_model("resnet20_s2d")
+    assert model.stem_space_to_depth
+    topo = HiPSTopology(num_parties=1, workers_per_party=2)
+    trainer = Trainer(model, topo, optax.sgd(0.05, momentum=0.9),
+                      sync=FSA())
+    rng = np.random.RandomState(0)
+    x = (rng.rand(1, 2, 4, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(1, 2, 4)).astype(np.int32)
+    sharding = topo.batch_sharding(trainer.mesh)
+    state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    losses = []
+    for _ in range(3):
+        state, metrics = trainer.train_step(
+            state, jax.device_put(x, sharding), jax.device_put(y, sharding))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # same tiny batch refit: loss must drop

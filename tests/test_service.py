@@ -434,14 +434,15 @@ def test_wire_stats_and_verbose_logging(monkeypatch, capfd):
 
     monkeypatch.setenv("GEOMX_PS_VERBOSE", "2")
     reset_verbose_cache()  # the level is cached off the hot path
+    # (the fixture reverts the env at teardown; the next _verbose_level
+    # call after our finally-reset re-reads it)
     try:
-        _run_wire_stats_body(monkeypatch, capfd, wire_stats)
+        _run_wire_stats_body(capfd, wire_stats)
     finally:
-        monkeypatch.undo()
         reset_verbose_cache()
 
 
-def _run_wire_stats_body(monkeypatch, capfd, wire_stats):
+def _run_wire_stats_body(capfd, wire_stats):
     before = wire_stats.snapshot()
     server = GeoPSServer(num_workers=1, mode="sync").start()
     c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
