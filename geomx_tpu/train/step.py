@@ -105,6 +105,18 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                 f"algorithms ({sync.name}) do not compose with it")
         mgps = MultiGPSPlan(config.bigarray_bound, topology.workers_per_party)
         from geomx_tpu.compression.base import NoCompressor
+        from geomx_tpu.sync.dgt import DGTCompressor
+        if isinstance(sync.worker_compressor, DGTCompressor):
+            # DGT's state is one flat schedule for the WHOLE gradient
+            # (sync/dgt.py tree-level path); the MultiGPS update needs
+            # per-leaf compressor state because big leaves bypass the
+            # worker compressor entirely.  DGT is a WAN transport — put
+            # it on the dc tier (where sync/__init__.py wires it); an
+            # ICI-tier deferral would save nothing anyway.
+            raise ValueError(
+                "GEOMX_MULTI_GPS does not compose with DGT as the "
+                "worker-tier compressor; configure DGT on the dc tier "
+                "(enable_dgt wraps the dc compressor)")
         if not isinstance(sync.worker_compressor, NoCompressor):
             import warnings
             # big leaves' worker-tier reduce is the psum_scatter itself

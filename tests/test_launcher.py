@@ -6,21 +6,56 @@ launcher runs the real multi-process HiPS PS demo end-to-end, all-local.
 """
 
 import os
+import socket
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _free_port_blocks(*sizes: int):
+    """One OS-assigned base port per requested block size, each with
+    size-1 consecutive free successors (the PS plane derives per-party
+    ports as base + party_id).  Every reservation socket is held open
+    until ALL blocks are chosen, so blocks never overlap each other;
+    binding instead of guessing from the pid lets two pytest runs share
+    the machine — each gets distinct ephemeral ports from the kernel."""
+    held, bases = [], []
+    try:
+        for n in sizes:
+            for attempt in range(64):
+                socks = []
+                try:
+                    s0 = socket.socket()
+                    s0.bind(("127.0.0.1", 0))
+                    base = s0.getsockname()[1]
+                    socks.append(s0)
+                    for i in range(1, n):
+                        s = socket.socket()
+                        s.bind(("127.0.0.1", base + i))
+                        socks.append(s)
+                    held.extend(socks)
+                    bases.append(base)
+                    break
+                except (OSError, OverflowError):  # Overflow: base+i > 65535
+                    for s in socks:
+                        s.close()
+            else:
+                raise RuntimeError("could not reserve a free port block")
+    finally:
+        for s in held:
+            s.close()
+    return bases
+
+
 def test_local_launch_end_to_end():
+    gport, lport = _free_port_blocks(1, 2)
     env = dict(os.environ)
     env.update({
         "GEOMX_EPOCHS": "1",
         "GEOMX_BATCH": "64",
-        # unique ports per run: back-to-back runs on fixed ports can
-        # collide with a predecessor's lingering listener
-        "GEOMX_PS_GLOBAL_PORT": str(20000 + os.getpid() % 2000),
-        "GEOMX_PS_PORT": str(23000 + os.getpid() % 2000),
+        "GEOMX_PS_GLOBAL_PORT": str(gport),
+        "GEOMX_PS_PORT": str(lport),
         "JAX_PLATFORMS": "cpu",
     })
     env.pop("XLA_FLAGS", None)  # single-device CPU is fine for the workers
@@ -40,6 +75,7 @@ def test_local_launch_with_scheduler_discovery():
     """GEOMX_USE_SCHEDULER=1: the launcher spawns the scheduler role and
     every process discovers peer addresses through it (the reference's
     ADD_NODE flow) — end to end, plus MultiGPS sharding."""
+    sched_port, gport, lport = _free_port_blocks(1, 2, 2)
     env = dict(os.environ)
     env.update({
         "GEOMX_EPOCHS": "1",
@@ -47,9 +83,9 @@ def test_local_launch_with_scheduler_discovery():
         "GEOMX_USE_SCHEDULER": "1",
         "GEOMX_NUM_GLOBAL_SERVERS": "2",
         "GEOMX_BIGARRAY_BOUND": "300",
-        "GEOMX_SCHEDULER_PORT": str(25000 + os.getpid() % 2000),
-        "GEOMX_PS_GLOBAL_PORT": str(27000 + os.getpid() % 2000),
-        "GEOMX_PS_PORT": str(29000 + os.getpid() % 2000),
+        "GEOMX_SCHEDULER_PORT": str(sched_port),
+        "GEOMX_PS_GLOBAL_PORT": str(gport),
+        "GEOMX_PS_PORT": str(lport),
         "JAX_PLATFORMS": "cpu",
     })
     env.pop("XLA_FLAGS", None)

@@ -1,0 +1,99 @@
+"""SSH hostfile launch path — the dmlc_ssh.py tracker analogue.
+
+The reference tracker launches every role with ``ssh host 'env ... cmd'``
+(3rdparty/ps-lite/tracker/dmlc_ssh.py:28-60).  scripts/launch.py's
+--hostfile branch builds the same shape of command: env assignments
+marshalled into the remote string, the remote pid recorded to a pidfile
+before exec (for cleanup), the launcher interpreter translated to bare
+python3.  This test drives that branch end-to-end through a mock ``ssh``
+on PATH that logs its argv and executes the remote command string
+locally — so everything EXCEPT the TCP transport to another machine is
+the real code path, including the post-run cleanup ssh.
+"""
+
+import os
+import socket
+import stat
+import subprocess
+import sys
+
+from test_launcher import _free_port_blocks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MOCK_SSH = """#!/bin/sh
+# mock ssh: log the call, drop options, run the remote command locally
+echo "ssh $*" >> "$MOCK_SSH_LOG"
+while true; do
+  case "$1" in
+    -o) shift 2 ;;
+    -*) shift ;;
+    *) break ;;
+  esac
+done
+host="$1"; shift
+exec sh -c "$*"
+"""
+
+
+def test_hostfile_ssh_launch_end_to_end(tmp_path):
+    # the machine's own hostname: resolvable, but NOT in launch.py's
+    # is_local() list — so the ssh branch fires for every role
+    host = socket.gethostname()
+    try:
+        socket.gethostbyname(host)
+    except OSError:
+        import pytest
+        pytest.skip(f"hostname {host!r} does not resolve")
+
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "ssh"
+    shim.write_text(MOCK_SSH)
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / "ssh.log"
+    log.write_text("")
+
+    hostfile = tmp_path / "hosts.txt"
+    # first host runs the global server; parties round-robin the rest
+    hostfile.write_text(f"{host}\n{host}\n# a comment line\n\n")
+
+    gport, lport = _free_port_blocks(1, 2)
+    env = dict(os.environ)
+    env.update({
+        "PATH": f"{shim_dir}:{env['PATH']}",
+        "MOCK_SSH_LOG": str(log),
+        "GEOMX_EPOCHS": "1",
+        "GEOMX_BATCH": "64",
+        "GEOMX_PS_GLOBAL_PORT": str(gport),
+        "GEOMX_PS_PORT": str(lport),
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "scripts/launch.py",
+         "--hostfile", str(hostfile),
+         "--num-parties", "2", "--workers-per-party", "1",
+         "--server-start-delay", "0.5",
+         "--", sys.executable, "examples/dist_ps.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+    # the job actually trained: both workers reported, servers stopped
+    assert proc.stdout.count("test_acc") >= 2, proc.stdout
+    assert "[global_server 0] stopped" in proc.stdout, proc.stdout
+
+    calls = [ln for ln in log.read_text().splitlines() if ln]
+    # 1 global server + 2 party servers + 2 workers over ssh, plus the
+    # cleanup ssh that kills recorded remote pids
+    assert len(calls) >= 6, calls
+    spawn_calls = [c for c in calls if "dist_ps.py" in c]
+    assert len(spawn_calls) == 5, spawn_calls
+    for c in spawn_calls:
+        assert f" {host} " in c, c
+        # the launcher's venv interpreter must have been translated to
+        # bare python3 for the remote side (dmlc_ssh semantics)
+        assert sys.executable not in c.split(host, 1)[1], c
+        assert "echo $$ >>" in c, c  # remote pid recorded for cleanup
+    cleanup_calls = [c for c in calls if ".pids" in c and "kill" in c]
+    assert cleanup_calls, calls

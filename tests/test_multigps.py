@@ -111,6 +111,20 @@ def test_multigps_requires_fsa(topo2x4):
                 sync=HFA(k1=2, k2=2), config=cfg)
 
 
+def test_multigps_rejects_dgt_worker_compressor(topo2x4):
+    """DGT's tree-level state (one flat schedule for the whole gradient)
+    cannot be flattened per-leaf the way the MultiGPS update needs;
+    configuring it on the worker tier must fail loudly, steering the
+    user to the dc tier where enable_dgt wires it."""
+    from geomx_tpu.sync import FSA, DGTCompressor
+
+    cfg = GeoConfig(num_parties=2, workers_per_party=4, multi_gps=True,
+                    bigarray_bound=BOUND)
+    with pytest.raises(ValueError, match="DGT"):
+        Trainer(MLP(hidden=(64,)), topo2x4, optax.sgd(0.05),
+                sync=FSA(worker_compressor=DGTCompressor()), config=cfg)
+
+
 def test_multigps_with_adam_and_compression(topo2x4, rng):
     """Adam state shards and a dc-tier fp16 compressor on the mixed tree
     still converge (loss decreases) — the config run_multi_gps.sh drives."""
