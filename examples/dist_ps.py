@@ -300,6 +300,14 @@ def run_worker():
         for k in sorted(params):
             params[k] = c.pull(k)
 
+    save_dir = env("GEOMX_SAVE_PARAMS")
+    if save_dir:
+        # cross-plane verification hook (__graft_entry__ host-PS smoke):
+        # the final pulled weights, for comparison against the SPMD run
+        np.savez(os.path.join(save_dir,
+                              f"worker_p{PARTY_ID}w{WORKER_ID}.npz"),
+                 **params)
+
     c.barrier()
     # every worker sends kStopServer; the local server stops once all its
     # workers have, then forwards the stop up (reference

@@ -25,8 +25,10 @@ def get_model(name: str, num_classes: int = 10):
     if name == "resnet20":
         return ResNet20(num_classes=num_classes)
     if name in ("resnet20_s2d", "resnet20-s2d"):
-        # TPU stem experiment: 2x2 space-to-depth (see models/resnet.py)
-        return ResNet20(num_classes=num_classes, space_to_depth=True)
+        # TPU-optimized variant: 2x2 space-to-depth stem + MXU-friendly
+        # transition shortcuts (see models/resnet.py)
+        return ResNet20(num_classes=num_classes, space_to_depth=True,
+                        mxu_shortcuts=True)
     if name == "resnet32":
         return ResNet32(num_classes=num_classes)
     if name == "resnet56":

@@ -72,6 +72,7 @@ class GeoPSClient:
         merged and re-announced — the scheduler-chosen aggregation tree of
         the reference (kv_app.h:313-341, kvstore_dist.h:91-169)."""
         self.sender_id = sender_id
+        self.addr = addr
         self._autopull: Dict[str, Any] = {}
         self._apevents: Dict[str, threading.Event] = {}
         self._aplock = threading.Lock()
@@ -884,11 +885,17 @@ class GeoPSClient:
     def heartbeat(self) -> None:
         self._request(Msg(MsgType.HEARTBEAT))
 
-    def stop_server(self) -> None:
+    def stop_server(self) -> bool:
+        """Send kStopServer; True iff the server ACKed it.  False means
+        the STOP may never have left this client (e.g. it timed out in a
+        send queue that close() is about to discard) — a caller tearing
+        down a tier must retry on a fresh connection or the server
+        strands listening forever."""
         try:
             self._request(Msg(MsgType.STOP), timeout=5.0)
+            return True
         except (ConnectionError, OSError, TimeoutError):
-            pass
+            return False
 
     def close(self) -> None:
         if self._closed:

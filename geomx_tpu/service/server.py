@@ -108,6 +108,10 @@ class GeoPSServer:
         self._lock = threading.Lock()
         self._barrier_waiters = []
         self._stops = 0
+        # set when stop() has fully completed (incl. forwarding STOP up
+        # the tier); join() gates on it so the process cannot exit with
+        # the forward half-done (see stop())
+        self._stop_done = threading.Event()
         self._seen_pushes: Dict[Any, bool] = {}
         # MultiGPS placement per key: key -> (owner, bounds); bounds is a
         # cumulative split across all global servers for big tensors,
@@ -303,7 +307,22 @@ class GeoPSServer:
     def stop(self, forward: bool = True):
         """``forward=False`` detaches from the global tier WITHOUT
         sending kStopServer up — the rolling-restart/crash case, where a
-        replacement server will re-register under the same identity."""
+        replacement server will re-register under the same identity.
+
+        stop() usually runs on a daemon handler thread (the worker-STOP
+        path).  Closing the listen socket below unblocks join() in the
+        MAIN thread, which may then exit the process and kill this
+        daemon thread before the STOP-forward loop finishes — the
+        global tier then waits for a stop that died mid-loop and
+        strands past any launcher deadline (r5 flake: one global server
+        of two received a single STOP).  join() therefore also gates on
+        _stop_done, set in the ``finally`` here."""
+        try:
+            self._stop_impl(forward)
+        finally:
+            self._stop_done.set()
+
+    def _stop_impl(self, forward: bool):
         self._running = False
         with self._lock:
             for q in self._relay_qs.values():
@@ -326,15 +345,44 @@ class GeoPSServer:
             except OSError:
                 pass
         for c in self._gclients:
+            ok = not forward
+            if forward:
+                try:
+                    ok = c.stop_server()
+                except Exception:
+                    ok = False
+            if forward and not ok:
+                # the STOP timed out in (or never left) a send queue the
+                # close() below will discard — without it the global tier
+                # strands listening past any launcher deadline (r5 flake:
+                # global_server 0 hung after a lost stop).  Retry once on
+                # a bare short-timeout socket with the frame written
+                # directly — no send queue to lose it in, no bring-up
+                # retry loop to stall THIS server's shutdown if the
+                # global already exited.  A duplicate STOP is safe: the
+                # stop counter can only over-count at shutdown time.
+                try:
+                    retry = socket.create_connection(c.addr, timeout=2.0)
+                    retry.settimeout(5.0)
+                    send_frame(retry, Msg(MsgType.STOP,
+                                          sender=c.sender_id))
+                    recv_frame(retry)  # best-effort ACK read
+                    retry.close()
+                except Exception:
+                    pass
             try:
-                if forward:
-                    c.stop_server()
                 c.close()
             except OSError:
                 pass
 
     def join(self, timeout: Optional[float] = None):
         self._accept_thread.join(timeout)
+        if not self._running:
+            # a stop() is in flight (likely on a daemon handler thread):
+            # wait for its forward/teardown to finish before letting the
+            # caller exit the process.  Bounded so a stop() wedged in a
+            # remote send can never hang the host process forever.
+            self._stop_done.wait(timeout if timeout is not None else 60.0)
 
     # ---- networking --------------------------------------------------------
 

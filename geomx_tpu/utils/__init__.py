@@ -4,5 +4,7 @@ profiling, failure detection."""
 from geomx_tpu.utils.metrics import Measure
 from geomx_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
+from geomx_tpu.utils.net import free_port_blocks
 
-__all__ = ["Measure", "save_checkpoint", "load_checkpoint", "HeartbeatMonitor"]
+__all__ = ["Measure", "save_checkpoint", "load_checkpoint",
+           "HeartbeatMonitor", "free_port_blocks"]

@@ -1,0 +1,211 @@
+// gx_im2rec — native dataset packer (the reference's tools/im2rec.cc,
+// re-scoped for this framework's data plane).
+//
+// The reference ships im2rec as a standalone C++ utility that walks an
+// image list and packs encoded images + labels into dmlc recordio
+// (reference: tools/im2rec.cc).  Its decode path is OpenCV; this image
+// has no image codecs, so the native packer supports the two sources
+// that need none:
+//
+//   gx_im2rec cifar-bin <out.rec> <batch.bin> [...]
+//       CIFAR-10/100-style binary batches (1 label byte + C*H*W uint8
+//       planes, CHW) -> HWC labelled records
+//   gx_im2rec images <out.rec> <folder>
+//       class-per-subdirectory folder of binary PPM (P6) / PGM (P5)
+//       images; the class index in sorted order is the label
+//
+// Records are byte-identical to geomx_tpu.data.recordio.pack_labelled
+// ("<Ifhhh" header + raw uint8 HWC pixels) inside the same recordio
+// framing (gx_recio_* in geops_runtime.cpp), so Python readers
+// (RecordIOReader / ImageRecordIter) consume the output directly.
+//
+// Build: make im2rec   (links the writer from geops_runtime.cpp)
+
+#include <algorithm>
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* gx_recio_writer_open(const char* path, int with_index);
+int64_t gx_recio_write(void* h, const uint8_t* data, int64_t len,
+                       int64_t key, int has_key);
+int gx_recio_writer_close(void* h);
+}
+
+namespace fs = std::filesystem;
+
+// pack_labelled layout: little-endian u32 label-count marker (1),
+// f32 label, i16 h, i16 w, i16 c — 14 bytes, no padding — then pixels.
+static void pack_header(std::vector<uint8_t>& buf, float label,
+                        int16_t h, int16_t w, int16_t c) {
+  buf.resize(14);
+  uint32_t one = 1;
+  std::memcpy(buf.data() + 0, &one, 4);
+  std::memcpy(buf.data() + 4, &label, 4);
+  std::memcpy(buf.data() + 8, &h, 2);
+  std::memcpy(buf.data() + 10, &w, 2);
+  std::memcpy(buf.data() + 12, &c, 2);
+}
+
+static int write_record(void* wr, float label, int16_t h, int16_t w,
+                        int16_t c, const uint8_t* hwc) {
+  std::vector<uint8_t> payload;
+  pack_header(payload, label, h, w, c);
+  payload.insert(payload.end(), hwc,
+                 hwc + int64_t(h) * w * c);
+  return gx_recio_write(wr, payload.data(),
+                        static_cast<int64_t>(payload.size()), 0, 0) >= 0
+             ? 0
+             : -1;
+}
+
+// ---------------------------------------------------------------------------
+// cifar-bin: [label u8][R plane 1024][G plane 1024][B plane 1024] x N
+// ---------------------------------------------------------------------------
+
+static int pack_cifar_bin(void* wr, const char* path, int64_t* count) {
+  constexpr int H = 32, W = 32, C = 3;
+  constexpr size_t rec = 1 + H * W * C;
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    std::fprintf(stderr, "gx_im2rec: cannot open %s\n", path);
+    return -1;
+  }
+  std::vector<uint8_t> raw(rec), hwc(H * W * C);
+  while (fread(raw.data(), 1, rec, f) == rec) {
+    // CHW planes -> interleaved HWC
+    for (int y = 0; y < H; ++y)
+      for (int x = 0; x < W; ++x)
+        for (int ch = 0; ch < C; ++ch)
+          hwc[(y * W + x) * C + ch] = raw[1 + ch * H * W + y * W + x];
+    if (write_record(wr, float(raw[0]), H, W, C, hwc.data()) != 0) {
+      fclose(f);
+      return -1;
+    }
+    ++*count;
+  }
+  fclose(f);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// images: binary PPM (P6, RGB) / PGM (P5, gray) under class subfolders
+// ---------------------------------------------------------------------------
+
+static int pnm_token(FILE* f, long* out) {
+  // whitespace/comment-tolerant integer scan, per the PNM spec
+  int ch;
+  for (;;) {
+    ch = fgetc(f);
+    if (ch == '#') {
+      while (ch != '\n' && ch != EOF) ch = fgetc(f);
+    } else if (!isspace(ch)) {
+      break;
+    }
+  }
+  if (ch == EOF) return -1;
+  long v = 0;
+  while (isdigit(ch)) {
+    v = v * 10 + (ch - '0');
+    ch = fgetc(f);
+  }
+  *out = v;
+  return 0;
+}
+
+static int pack_pnm(void* wr, const fs::path& path, float label) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return -1;
+  char magic[3] = {0, 0, 0};
+  if (fread(magic, 1, 2, f) != 2 ||
+      magic[0] != 'P' || (magic[1] != '5' && magic[1] != '6')) {
+    fclose(f);
+    std::fprintf(stderr, "gx_im2rec: %s is not binary PGM/PPM — skipped\n",
+                 path.c_str());
+    return 1;  // skip, not fatal: mirrors the reference tool's tolerance
+  }
+  int c = magic[1] == '6' ? 3 : 1;
+  long w = 0, h = 0, maxv = 0;
+  if (pnm_token(f, &w) || pnm_token(f, &h) || pnm_token(f, &maxv) ||
+      w <= 0 || h <= 0 || w > 32767 || h > 32767 || maxv != 255) {
+    fclose(f);
+    std::fprintf(stderr, "gx_im2rec: unsupported PNM header in %s\n",
+                 path.c_str());
+    return 1;
+  }
+  std::vector<uint8_t> px(size_t(w) * h * c);
+  size_t got = fread(px.data(), 1, px.size(), f);
+  fclose(f);
+  if (got != px.size()) {
+    std::fprintf(stderr, "gx_im2rec: truncated pixels in %s\n", path.c_str());
+    return 1;
+  }
+  // P6/P5 binary pixel order IS row-major interleaved == HWC
+  return write_record(wr, label, int16_t(h), int16_t(w), int16_t(c),
+                      px.data()) == 0
+             ? 0
+             : -1;
+}
+
+static int pack_folder(void* wr, const char* folder, int64_t* count) {
+  std::vector<fs::path> classes;
+  for (const auto& e : fs::directory_iterator(folder))
+    if (e.is_directory()) classes.push_back(e.path());
+  std::sort(classes.begin(), classes.end());
+  if (classes.empty()) {
+    std::fprintf(stderr, "gx_im2rec: no class subdirectories in %s\n",
+                 folder);
+    return -1;
+  }
+  for (size_t label = 0; label < classes.size(); ++label) {
+    std::vector<fs::path> files;
+    for (const auto& e : fs::directory_iterator(classes[label]))
+      if (e.is_regular_file()) files.push_back(e.path());
+    std::sort(files.begin(), files.end());
+    for (const auto& p : files) {
+      int rc = pack_pnm(wr, p, float(label));
+      if (rc < 0) return -1;
+      if (rc == 0) ++*count;
+    }
+  }
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: gx_im2rec cifar-bin <out.rec> <batch.bin> [...]\n"
+                 "       gx_im2rec images    <out.rec> <folder>\n");
+    return 2;
+  }
+  const std::string mode = argv[1];
+  void* wr = gx_recio_writer_open(argv[2], /*with_index=*/1);
+  if (!wr) {
+    std::fprintf(stderr, "gx_im2rec: cannot open %s for writing\n", argv[2]);
+    return 1;
+  }
+  int64_t count = 0;
+  int rc = 0;
+  if (mode == "cifar-bin") {
+    for (int i = 3; i < argc && rc == 0; ++i)
+      rc = pack_cifar_bin(wr, argv[i], &count);
+  } else if (mode == "images") {
+    rc = pack_folder(wr, argv[3], &count);
+  } else {
+    std::fprintf(stderr, "gx_im2rec: unknown mode %s\n", mode.c_str());
+    rc = -1;
+  }
+  if (gx_recio_writer_close(wr) != 0) {
+    std::fprintf(stderr, "gx_im2rec: flush/close failed (disk full?)\n");
+    rc = -1;
+  }
+  if (rc == 0)
+    std::printf("gx_im2rec: packed %lld records into %s\n",
+                static_cast<long long>(count), argv[2]);
+  return rc == 0 ? 0 : 1;
+}

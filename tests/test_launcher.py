@@ -6,50 +6,16 @@ launcher runs the real multi-process HiPS PS demo end-to-end, all-local.
 """
 
 import os
-import socket
 import subprocess
 import sys
+
+from geomx_tpu.utils import free_port_blocks
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_port_blocks(*sizes: int):
-    """One OS-assigned base port per requested block size, each with
-    size-1 consecutive free successors (the PS plane derives per-party
-    ports as base + party_id).  Every reservation socket is held open
-    until ALL blocks are chosen, so blocks never overlap each other;
-    binding instead of guessing from the pid lets two pytest runs share
-    the machine — each gets distinct ephemeral ports from the kernel."""
-    held, bases = [], []
-    try:
-        for n in sizes:
-            for attempt in range(64):
-                socks = []
-                try:
-                    s0 = socket.socket()
-                    s0.bind(("127.0.0.1", 0))
-                    base = s0.getsockname()[1]
-                    socks.append(s0)
-                    for i in range(1, n):
-                        s = socket.socket()
-                        s.bind(("127.0.0.1", base + i))
-                        socks.append(s)
-                    held.extend(socks)
-                    bases.append(base)
-                    break
-                except (OSError, OverflowError):  # Overflow: base+i > 65535
-                    for s in socks:
-                        s.close()
-            else:
-                raise RuntimeError("could not reserve a free port block")
-    finally:
-        for s in held:
-            s.close()
-    return bases
-
-
 def test_local_launch_end_to_end():
-    gport, lport = _free_port_blocks(1, 2)
+    gport, lport = free_port_blocks(1, 2)
     env = dict(os.environ)
     env.update({
         "GEOMX_EPOCHS": "1",
@@ -75,7 +41,7 @@ def test_local_launch_with_scheduler_discovery():
     """GEOMX_USE_SCHEDULER=1: the launcher spawns the scheduler role and
     every process discovers peer addresses through it (the reference's
     ADD_NODE flow) — end to end, plus MultiGPS sharding."""
-    sched_port, gport, lport = _free_port_blocks(1, 2, 2)
+    sched_port, gport, lport = free_port_blocks(1, 2, 2)
     env = dict(os.environ)
     env.update({
         "GEOMX_EPOCHS": "1",

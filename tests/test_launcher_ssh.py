@@ -17,7 +17,7 @@ import stat
 import subprocess
 import sys
 
-from test_launcher import _free_port_blocks
+from geomx_tpu.utils import free_port_blocks
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -58,7 +58,7 @@ def test_hostfile_ssh_launch_end_to_end(tmp_path):
     # first host runs the global server; parties round-robin the rest
     hostfile.write_text(f"{host}\n{host}\n# a comment line\n\n")
 
-    gport, lport = _free_port_blocks(1, 2)
+    gport, lport = free_port_blocks(1, 2)
     env = dict(os.environ)
     env.update({
         "PATH": f"{shim_dir}:{env['PATH']}",

@@ -55,7 +55,10 @@ def _train(sp_mode, parties, workers, sp):
     return losses, params
 
 
-@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("sp_mode", [
+    "ring",
+    pytest.param("ulysses", marks=pytest.mark.tier2),
+])
 def test_sp_training_matches_unsharded(sp_mode):
     """(2 workers x 4 sp) == (2 workers, no sp): identical losses and
     final params up to float tolerance."""
@@ -68,6 +71,7 @@ def test_sp_training_matches_unsharded(sp_mode):
         np.testing.assert_allclose(s, b, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.tier2
 def test_sp_composes_with_hips_mesh():
     """Full 3-D composition (2 dc x 2 worker x 2 sp): data parallelism
     across both HiPS tiers with the sequence sharded inside each replica
@@ -77,6 +81,7 @@ def test_sp_composes_with_hips_mesh():
     np.testing.assert_allclose(sp_losses, base_losses, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.tier2
 def test_example_converges():
     """The shipped example learns the needle task (the attention-required
     signal) on the virtual mesh."""

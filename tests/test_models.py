@@ -63,6 +63,7 @@ def test_resnet20_space_to_depth_variant_trains():
 
     model = get_model("resnet20_s2d")
     assert model.stem_space_to_depth
+    assert model.mxu_shortcuts
     topo = HiPSTopology(num_parties=1, workers_per_party=2)
     trainer = Trainer(model, topo, optax.sgd(0.05, momentum=0.9),
                       sync=FSA())
@@ -78,3 +79,28 @@ def test_resnet20_space_to_depth_variant_trains():
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # same tiny batch refit: loss must drop
+
+
+def test_resnet20_mxu_shortcuts_projection_shape():
+    """mxu_shortcuts replaces the stride-2 1x1 projection (contraction
+    cin, 3/4 of activations discarded) with space_to_depth + unstrided
+    1x1 (contraction 4*cin, lossless): same output shapes, 4x the MXU
+    systolic fill on the projection matmul."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomx_tpu.models import ResNet20
+
+    model = ResNet20(num_classes=10, mxu_shortcuts=True)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    # the two transition shortcuts contract over 4*cin channels
+    kernels = {
+        "/".join(str(k.key) for k in path): leaf.shape
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            variables["params"])
+        if leaf.ndim == 4 and leaf.shape[:2] == (1, 1)
+    }
+    assert sorted(s[2] for s in kernels.values()) == [64, 128], kernels

@@ -111,6 +111,33 @@ def test_multigps_requires_fsa(topo2x4):
                 sync=HFA(k1=2, k2=2), config=cfg)
 
 
+def test_multigps_composes_with_dc_tier_dgt(topo2x4, rng):
+    """The combination the worker-tier rejection message recommends must
+    actually work: enable_dgt wraps the dc compressor, whose tree-level
+    state the Trainer sizes from the MIXED (shard-shaped) tree — big
+    leaves cross the WAN as 1/W scatter shards under one DGT schedule."""
+    from geomx_tpu.sync import get_sync_algorithm
+
+    cfg = GeoConfig(num_parties=2, workers_per_party=4, multi_gps=True,
+                    bigarray_bound=BOUND, enable_dgt=1,
+                    dgt_block_size=256, udp_channel_num=3)
+    sync = get_sync_algorithm(cfg)
+    assert sync.dc_compressor.name == "dgt"
+    trainer = Trainer(MLP(hidden=(64,)), topo2x4, optax.sgd(0.05),
+                      sync=sync, config=cfg)
+    x = (rng.rand(2, 4, 8, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(2, 4, 8)).astype(np.int32)
+    sharding = topo2x4.batch_sharding(trainer.mesh)
+    state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    losses = []
+    for _ in range(3):
+        state, metrics = trainer.train_step(
+            state, jax.device_put(x, sharding), jax.device_put(y, sharding))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
 def test_multigps_rejects_dgt_worker_compressor(topo2x4):
     """DGT's tree-level state (one flat schedule for the whole gradient)
     cannot be flattened per-leaf the way the MultiGPS update needs;

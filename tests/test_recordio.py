@@ -100,6 +100,63 @@ def test_im2rec_tool_end_to_end(tmp_path):
         assert img.shape == (32, 32, 3)
 
 
+def test_native_im2rec_cifar_bin_and_ppm(tmp_path):
+    """The standalone C++ packer (native/im2rec.cpp, the reference's
+    tools/im2rec.cc equivalent) produces byte-level pack_labelled
+    records the Python reader consumes: CIFAR binary batches (CHW
+    planes -> HWC) and a PPM class-folder, labels and pixels intact."""
+    import shutil
+
+    gx = os.path.join(REPO, "native", "gx_im2rec")
+    if not os.path.exists(gx):
+        if shutil.which("g++") is None:
+            pytest.skip("no C++ toolchain")
+        proc = subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                               "im2rec"], capture_output=True, text=True)
+        if proc.returncode != 0:
+            pytest.skip(f"native build failed: {proc.stderr[-500:]}")
+
+    rng = np.random.RandomState(0)
+    # CIFAR-10 binary layout: [label u8][3x32x32 CHW planes] per record
+    n = 7
+    labels = rng.randint(0, 10, size=n).astype(np.uint8)
+    chw = rng.randint(0, 256, size=(n, 3, 32, 32)).astype(np.uint8)
+    bin_path = tmp_path / "data_batch_1.bin"
+    with open(bin_path, "wb") as f:
+        for i in range(n):
+            f.write(bytes([labels[i]]) + chw[i].tobytes())
+    out = str(tmp_path / "cifar.rec")
+    proc = subprocess.run([gx, "cifar-bin", out, str(bin_path)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    with RecordIOReader(out) as r:
+        assert len(r) == n
+        for i in range(n):
+            label, img = unpack_labelled(r.read_idx(i))
+            assert label == labels[i]
+            np.testing.assert_array_equal(
+                img, chw[i].transpose(1, 2, 0))
+
+    # PPM (P6) class folder: class order = sorted subdir names
+    for cls, color in (("a_cats", 10), ("b_dogs", 200)):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        px = np.full((4, 5, 3), color, np.uint8)
+        with open(d / "img0.ppm", "wb") as f:
+            f.write(b"P6\n5 4\n255\n" + px.tobytes())
+    out2 = str(tmp_path / "imgs.rec")
+    proc = subprocess.run([gx, "images", out2, str(tmp_path / "imgs")],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    with RecordIOReader(out2) as r:
+        assert len(r) == 2
+        l0, img0 = unpack_labelled(r.read_idx(0))
+        l1, img1 = unpack_labelled(r.read_idx(1))
+        assert (l0, l1) == (0.0, 1.0)
+        assert img0.shape == (4, 5, 3)
+        assert int(img0[0, 0, 0]) == 10 and int(img1[0, 0, 0]) == 200
+
+
 def test_prefetch_exhaustion_and_early_abandon(tmp_path):
     path = str(tmp_path / "d.rec")
     _write_dataset(path, n=32)
@@ -134,6 +191,7 @@ def test_out_of_range_part_index_raises(tmp_path):
         ImageRecordIter(path, batch_size=2, part_index=4, num_parts=4)
 
 
+@pytest.mark.tier2
 def test_recordio_training_example_converges():
     """The shipped example drives the full reference data path: pack to
     .rec (native writer when built), per-worker file shards via

@@ -46,6 +46,7 @@ def _fit(sync, data, steps=40, lr=0.01, batch=16, topo=None,
     return losses, acc, state, trainer
 
 
+@pytest.mark.tier2
 def test_fsa_converges(data):
     losses, acc, state, _ = _fit(FSA(), data, steps=40)
     assert losses[-1] < losses[0] * 0.7
@@ -72,6 +73,7 @@ def test_fsa_replicas_stay_in_sync(data):
                 np.testing.assert_allclose(arr[p, w], ref, atol=1e-6)
 
 
+@pytest.mark.tier2
 def test_fsa_bsc_converges(data):
     sync = FSA(dc_compressor=BiSparseCompressor(ratio=0.05, min_sparse_size=512))
     losses, acc, _, _ = _fit(sync, data, steps=50, lr=0.003)
@@ -79,18 +81,21 @@ def test_fsa_bsc_converges(data):
     assert acc > 0.4
 
 
+@pytest.mark.tier2
 def test_fsa_fp16_close_to_fp32(data):
     losses32, _, _, _ = _fit(FSA(), data, steps=10)
     losses16, _, _, _ = _fit(FSA(dc_compressor=FP16Compressor()), data, steps=10)
     np.testing.assert_allclose(losses16, losses32, rtol=0.05, atol=0.05)
 
 
+@pytest.mark.tier2
 def test_fsa_mpq_converges(data):
     sync = FSA(dc_compressor=MPQCompressor(ratio=0.05, size_lower_bound=100_000))
     losses, acc, _, _ = _fit(sync, data, steps=50, lr=0.003)
     assert losses[-1] < losses[0] * 0.5
 
 
+@pytest.mark.tier2
 def test_hfa_converges_and_drifts(data):
     sync = HFA(k1=2, k2=2)
     losses, acc, state, _ = _fit(sync, data, steps=50, lr=0.003)
@@ -134,6 +139,7 @@ def test_hfa_workers_drift_between_syncs(data):
     assert spread(state) < 1e-5
 
 
+@pytest.mark.tier2
 def test_mixed_sync_dcasgd_converges(data):
     sync = MixedSync(pull_interval=2, dcasgd_lambda=0.04)
     losses, acc, _, _ = _fit(sync, data, steps=80, lr=0.003)
@@ -146,11 +152,13 @@ def test_dgt_converges(data):
     assert losses[-1] < losses[0] * 0.5
 
 
+@pytest.mark.tier2
 def test_class_split_non_iid_loader(data):
     losses, acc, _, _ = _fit(FSA(), data, steps=30, split_by_class=True)
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.tier2
 def test_fit_eval_every_fires_without_log_every(data):
     topo = HiPSTopology(num_parties=2, workers_per_party=4)
     trainer = Trainer(GeoCNN(num_classes=10), topo, optax.adam(0.01), sync=FSA())
