@@ -467,9 +467,9 @@ def _time_to_accuracy(batch, model_kwargs=None):
 
     root = os.environ.get("GEOMX_DATA_DIR", "/root/data")
     fetch_note = None
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
     try:
-        sys.path.insert(0, os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "tools"))
         import fetch_cifar10
         if not fetch_cifar10.ensure(root, quiet=True):
             fetch_note = ("cifar10 absent and download failed (no egress "
@@ -477,8 +477,15 @@ def _time_to_accuracy(batch, model_kwargs=None):
                           "run tools/fetch_cifar10.py where network exists")
     except Exception as e:
         fetch_note = f"fetch_cifar10 unavailable: {e!r}"
+    finally:
+        sys.path.pop(0)
     data = load_dataset("cifar10", root=root, synthetic_train_n=8192)
     synthetic = data["synthetic"]
+    if not synthetic:
+        # real data found (fetched earlier, or pre-mounted under a layout
+        # ensure() does not probe, e.g. <root>/cifar10/...): a stale
+        # download-failure note would mislabel a real-CIFAR run
+        fetch_note = None
     target = float(os.environ.get("GEOMX_BENCH_TTA_TARGET",
                                   "0.90" if synthetic else "0.92"))
     max_epochs = int(os.environ.get("GEOMX_BENCH_TTA_EPOCHS", "40"))

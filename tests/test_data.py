@@ -122,3 +122,52 @@ def test_device_cache_loader_matches_host_path():
     np.testing.assert_array_equal(np.asarray(ya), np.asarray(yh))
     assert not np.array_equal(xa, np.asarray(xh))
     np.testing.assert_array_equal(xa, xa2)
+
+
+def test_real_cifar10_binary_layout_is_discovered(tmp_path):
+    """The auto-switch the bench TTA relies on (VERDICT r4 #4): when the
+    canonical cifar-10-batches-bin layout is present under the data
+    root — however it got there (tools/fetch_cifar10.py with egress, or
+    a pre-mounted volume) — load_dataset returns the REAL records with
+    synthetic=False.  The on-disk format is synthesized here, so the
+    branch is proven without network access."""
+    import os
+
+    from geomx_tpu.data import load_dataset
+
+    rng = np.random.RandomState(3)
+    bindir = tmp_path / "cifar10" / "cifar-10-batches-bin"
+    bindir.mkdir(parents=True)
+    per = 5  # records per batch file; format: [label u8][3072 CHW bytes]
+    raw = {}
+    for fname in [f"data_batch_{i}.bin" for i in range(1, 6)] + [
+            "test_batch.bin"]:
+        recs = np.concatenate(
+            [np.concatenate([[rng.randint(0, 10)],
+                             rng.randint(0, 256, size=3072)])[None]
+             for _ in range(per)]).astype(np.uint8)
+        recs.tofile(bindir / fname)
+        raw[fname] = recs
+
+    d = load_dataset("cifar10", root=str(tmp_path))
+    assert d["synthetic"] is False
+    assert d["train_x"].shape == (5 * per, 32, 32, 3)
+    assert d["test_x"].shape == (per, 32, 32, 3)
+    # first training record round-trips exactly (CHW planes -> HWC)
+    rec0 = raw["data_batch_1.bin"][0]
+    assert d["train_y"][0] == rec0[0]
+    np.testing.assert_array_equal(
+        d["train_x"][0], rec0[1:].reshape(3, 32, 32).transpose(1, 2, 0))
+
+    # and the fetch tool agrees the dataset is "present" at the SAME
+    # root the bench passes to ensure() (GEOMX_DATA_DIR), so the TTA
+    # phase attempts no download for a pre-mounted volume
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import fetch_cifar10
+        assert fetch_cifar10.present(str(tmp_path))
+        assert fetch_cifar10.ensure(str(tmp_path), quiet=True)
+    finally:
+        sys.path.pop(0)

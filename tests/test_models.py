@@ -12,7 +12,8 @@ import pytest
 
 from geomx_tpu.models import get_model
 
-ZOO = ["cnn", "mlp", "alexnet", "resnet20", "resnet18"]
+ZOO = ["cnn", "mlp", "alexnet", "resnet20",
+       pytest.param("resnet18", marks=pytest.mark.tier2)]
 
 
 @pytest.mark.parametrize("name", ZOO)
@@ -48,6 +49,7 @@ def test_unknown_model_raises():
         get_model("vgg99")
 
 
+@pytest.mark.tier2
 def test_resnet20_space_to_depth_variant_trains():
     """The flag-gated TPU stem experiment (bench config vanilla_s2d)
     trains: the 2x2 space-to-depth stem halves every stage's resolution

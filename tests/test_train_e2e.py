@@ -146,6 +146,7 @@ def test_mixed_sync_dcasgd_converges(data):
     assert losses[-1] < losses[0] * 0.5
 
 
+@pytest.mark.tier2
 def test_dgt_converges(data):
     sync = FSA(dc_compressor=DGTCompressor(block_elems=256, k=0.5, channels=3))
     losses, acc, _, _ = _fit(sync, data, steps=50, lr=0.003)

@@ -28,9 +28,19 @@ DIRNAME = "cifar-10-batches-bin"
 
 
 def present(root: str) -> bool:
-    d = os.path.join(root, DIRNAME)
+    """True iff the binary layout exists under any location
+    ``load_dataset("cifar10", root=root)`` probes — both
+    <root>/cifar10/cifar-10-batches-bin (pre-mounted volumes) and
+    <root>/cifar-10-batches-bin (this tool's own download target).
+    ensure() must agree with the loader, or a pre-mounted dataset
+    triggers a pointless (and in egress-less environments, slow)
+    download attempt before the loader finds the data anyway."""
     need = [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]
-    return all(os.path.exists(os.path.join(d, f)) for f in need)
+    for d in (os.path.join(root, "cifar10", DIRNAME),
+              os.path.join(root, DIRNAME)):
+        if all(os.path.exists(os.path.join(d, f)) for f in need):
+            return True
+    return False
 
 
 def ensure(root: str | None = None, quiet: bool = False,
