@@ -112,6 +112,16 @@ class GeoPSClient:
         self._key_rounds: Dict[str, int] = {}
         # DGT per-key per-block contribution EWMAs (push_dgt)
         self._dgt_contri: Dict[str, np.ndarray] = {}
+        # DSCP-marked per-channel sockets for deferred best-effort DGT
+        # chunks (reference zmq_van: one UDP socket per channel, each
+        # with a descending DSCP mark).  TCP here, but the IP-header
+        # marking is real: IP_TOS = dscp << 2 with standard AF classes,
+        # so network QoS can demote the deferred channels exactly as in
+        # the reference.  GEOMX_DGT_DSCP: comma ladder per channel
+        # (default "34,26,18,10" = AF41..AF11), "off"/"0" disables.
+        self._dgt_dscp = self._parse_dscp(os.environ.get("GEOMX_DGT_DSCP"))
+        self._dgt_ch_socks: Dict[int, tuple] = {}
+        self._dgt_ch_lock = threading.Lock()
         self._sock = connect_retry(addr)
         self._wlock = threading.Lock()
         # random rid base so a restarted worker reusing a sender_id cannot
@@ -460,6 +470,121 @@ class GeoPSClient:
         return self._submit(Msg(MsgType.PUSH, key=key, meta=m, array=g),
                             priority=priority)
 
+    # DSCP class names -> codepoints (AFxy = 8x + 2y, CSx = 8x, EF = 46)
+    _DSCP_NAMES = {
+        **{f"AF{x}{y}": 8 * x + 2 * y
+           for x in (1, 2, 3, 4) for y in (1, 2, 3)},
+        **{f"CS{x}": 8 * x for x in range(8)},
+        "EF": 46,
+    }
+
+    @classmethod
+    def _parse_dscp(cls, spec):
+        """GEOMX_DGT_DSCP -> list of per-channel DSCP codepoints.
+        Accepts integers 0-63 and standard class names (EF, AFxy, CSx).
+        Default descending assured-forwarding ladder AF41/AF31/AF21/AF11;
+        "off"/"0"/"" disables the per-channel sockets entirely."""
+        if spec is None or spec.strip() == "":
+            return [34, 26, 18, 10]
+        if spec.strip().lower() in ("off", "0", "none"):
+            return []
+        out = []
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            name = cls._DSCP_NAMES.get(tok.upper())
+            if name is not None:
+                out.append(name)
+                continue
+            try:
+                v = int(tok)
+            except ValueError:
+                raise ValueError(
+                    f"GEOMX_DGT_DSCP: {tok!r} is neither a DSCP "
+                    "codepoint (0-63) nor a class name (EF/AFxy/CSx)")
+            if not 0 <= v <= 63:
+                raise ValueError(
+                    f"GEOMX_DGT_DSCP: {v} outside the 6-bit field 0-63")
+            out.append(v)
+        return out
+
+    def _evict_channel(self, ch: int, s) -> None:
+        with self._dgt_ch_lock:
+            cur = self._dgt_ch_socks.get(ch)
+            if cur is not None and cur[0] is s:
+                del self._dgt_ch_socks[ch]
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    def _dgt_channel_send(self, msg: Msg, ch: int) -> bool:
+        """Handle a deferred chunk on channel ``ch``'s own DSCP-marked
+        socket: lazily connected, a drain thread discards the ACKs (so
+        the server's replies never back-pressure its handler) and evicts
+        the entry at EOF so a restarted server gets a fresh connection.
+        Sends carry a short timeout — a blocked channel SHEDS the chunk
+        (best-effort semantics; mid-frame state is unrecoverable, so the
+        socket is evicted too) instead of wedging the pusher.  Returns
+        True when the chunk was handled here (sent or shed); False =
+        channel path unavailable, caller falls back to the main socket's
+        priority queue — same send-order discipline, no IP marking."""
+        if not self._dgt_dscp:
+            return False
+        with self._dgt_ch_lock:
+            if self._closed:
+                return False
+            entry = self._dgt_ch_socks.get(ch)
+        if entry is None:
+            try:
+                s = socket.create_connection(self.addr, timeout=5.0)
+            except OSError:
+                return False
+            s.settimeout(2.0)
+            dscp = self._dgt_dscp[min(max(ch, 1) - 1,
+                                      len(self._dgt_dscp) - 1)]
+            try:
+                s.setsockopt(socket.IPPROTO_IP, socket.IP_TOS, dscp << 2)
+            except OSError:
+                pass  # marking is best-effort (e.g. odd stacks)
+
+            def _drain(sock=s, ch=ch):
+                try:
+                    while recv_frame(sock) is not None:
+                        pass
+                except (OSError, ValueError, pickle.UnpicklingError):
+                    pass
+                self._evict_channel(ch, sock)
+
+            with self._dgt_ch_lock:
+                if self._closed or ch in self._dgt_ch_socks:
+                    # lost a race with close() or another sender
+                    entry = self._dgt_ch_socks.get(ch)
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                    if entry is None:
+                        return False
+                else:
+                    entry = self._dgt_ch_socks[ch] = (s, threading.Lock())
+                    threading.Thread(target=_drain, daemon=True).start()
+        s, lk = entry
+        msg.sender = self.sender_id
+        msg.meta["rid"] = next(self._rid)
+        try:
+            with lk:
+                send_frame(s, msg)
+            return True
+        except socket.timeout:
+            self.dgt_shed_blocks += 1
+            self._evict_channel(ch, s)
+            return True
+        except OSError:
+            self._evict_channel(ch, s)
+            return False
+
     def push_dgt(self, key: str, grad: np.ndarray, priority: int = 0,
                  k: Optional[float] = None, block_elems: Optional[int] = None,
                  channels: Optional[int] = None,
@@ -549,9 +674,11 @@ class GeoPSClient:
                 if congested:
                     shed += 1
                     continue
-                self._submit(Msg(MsgType.PUSH, key=key, meta=m,
-                                 array=payload),
-                             priority=pr, fire_and_forget=True)
+                # channel's own DSCP-marked socket first (the reference's
+                # per-channel UDP + descending DSCP); main-queue fallback
+                msg = Msg(MsgType.PUSH, key=key, meta=m, array=payload)
+                if not self._dgt_channel_send(msg, ch):
+                    self._submit(msg, priority=pr, fire_and_forget=True)
                 continue
             rids.append(self._submit(
                 Msg(MsgType.PUSH, key=key, meta=m, array=payload),
@@ -907,6 +1034,13 @@ class GeoPSClient:
             self._sock.close()
         except OSError:
             pass
+        with self._dgt_ch_lock:
+            for s, _lk in self._dgt_ch_socks.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._dgt_ch_socks.clear()
         if self.ts_node is not None:
             try:
                 self._ts_listener.close()

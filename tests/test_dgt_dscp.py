@@ -1,0 +1,88 @@
+"""DGT per-channel DSCP marking — the reference's raw-UDP QoS ladder
+(zmq_van: one socket per channel, descending DSCP), re-expressed as
+per-channel TCP sockets with real IP_TOS marks.  The marking is what
+the reference's DSCP bought (network QoS can demote deferred channels);
+reliability comes from TCP instead of resend."""
+
+import socket
+
+import numpy as np
+import pytest
+
+from geomx_tpu.service import GeoPSClient, GeoPSServer
+from geomx_tpu.service.client import GeoPSClient as _C
+
+
+def test_dscp_ladder_parsing():
+    assert _C._parse_dscp(None) == [34, 26, 18, 10]
+    assert _C._parse_dscp("") == [34, 26, 18, 10]
+    assert _C._parse_dscp("off") == []
+    assert _C._parse_dscp("0") == []
+    assert _C._parse_dscp("46,34") == [46, 34]
+    # standard class names resolve (EF, AFxy, CSx)
+    assert _C._parse_dscp("EF,af41,cs1") == [46, 34, 8]
+    with pytest.raises(ValueError, match="0-63"):
+        _C._parse_dscp("99")
+    with pytest.raises(ValueError, match="class name"):
+        _C._parse_dscp("gold")
+
+
+def test_deferred_chunks_ride_dscp_marked_channel_sockets(monkeypatch):
+    """best-effort deferred blocks open one socket per channel, each
+    with IP_TOS = dscp << 2, and the push still merges exactly (the
+    server's (sender, key) assembly is connection-agnostic)."""
+    monkeypatch.setenv("GEOMX_DGT_DEADLINE_MS", "4000")
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    try:
+        assert c._dgt_dscp == [34, 26, 18, 10]
+        be, nb = 128, 12
+        n = be * nb
+        g = np.random.RandomState(0).randn(n).astype(np.float32)
+        c.init("w", np.zeros(n, np.float32))
+        c.push_dgt("w", g, k=0.5, block_elems=be, channels=3,
+                   best_effort=True)
+        out = c.pull("w", timeout=30.0, meta={"min_round": 1})
+
+        # channels 1..3 each got a socket with its ladder mark
+        assert sorted(c._dgt_ch_socks) == [1, 2, 3]
+        for ch, (s, _lk) in c._dgt_ch_socks.items():
+            tos = s.getsockopt(socket.IPPROTO_IP, socket.IP_TOS)
+            assert tos == _C._parse_dscp(None)[ch - 1] << 2, (ch, tos)
+
+        # no drops injected: every block (reliable f32 top-k + deferred
+        # fp16) must have merged despite arriving over 4 sockets
+        blocks_out = out.reshape(nb, be)
+        blocks_in = g.reshape(nb, be)
+        contri = np.abs(blocks_in).mean(axis=1)
+        order = np.argsort(-contri, kind="stable")
+        required = set(int(b) for b in order[:6])
+        for b in range(nb):
+            if b in required:
+                np.testing.assert_array_equal(blocks_out[b], blocks_in[b])
+            else:
+                np.testing.assert_array_equal(
+                    blocks_out[b],
+                    blocks_in[b].astype(np.float16).astype(np.float32))
+    finally:
+        c.stop_server()
+        c.close()
+
+
+def test_dscp_off_uses_the_main_socket(monkeypatch):
+    monkeypatch.setenv("GEOMX_DGT_DSCP", "off")
+    monkeypatch.setenv("GEOMX_DGT_DEADLINE_MS", "4000")
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    try:
+        be, nb = 64, 8
+        g = np.ones(be * nb, np.float32)
+        c.init("w", np.zeros(be * nb, np.float32))
+        c.push_dgt("w", g, k=0.5, block_elems=be, channels=3,
+                   best_effort=True)
+        out = c.pull("w", timeout=30.0, meta={"min_round": 1})
+        assert c._dgt_ch_socks == {}
+        assert out.sum() > 0
+    finally:
+        c.stop_server()
+        c.close()
