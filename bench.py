@@ -595,6 +595,17 @@ def child_main():
     on_tpu = devs[0].platform == "tpu"
     kind = devs[0].device_kind
     peak = _peak_flops(kind) if on_tpu else None
+    # compute-gate the backend-up signal: on a tunneled chip
+    # jax.devices() can succeed while actual dispatch hangs, and
+    # backend_up flips the parent watchdog from the (retried-in-a-fresh-
+    # child) init phase to the measurement phase — emit it only after a
+    # real matmul round-trips a value on EVERY device (one wedged chip
+    # of several must stay an init-phase failure, which retries fresh)
+    import jax.numpy as jnp
+    for d in devs:
+        a = jax.device_put(jnp.ones((256, 256)), d)
+        probe = float(jnp.sum(a @ a))
+        assert probe == 256.0 * 256 * 256, (d, probe)
     _emit({"event": "backend_up", "platform": devs[0].platform,
            "device_kind": kind, "n_devices": len(devs),
            "peak_bf16_flops": peak})
