@@ -462,3 +462,38 @@ def _run_wire_stats_body(capfd, wire_stats):
     assert "[geomx-wire]" in err and "PUSH" in err
     c.stop_server()
     c.close()
+
+
+def test_join_gates_on_stop_forward_completion(monkeypatch):
+    """Regression (r5 shutdown race): stop() runs on a daemon handler
+    thread when the last worker STOP arrives; join() returning as soon
+    as the listen socket closed let the MAIN thread exit the process
+    with the STOP-forward loop half done, stranding a global server.
+    join() must not return before the forward to the global tier has
+    completed — even when that forward is slow."""
+    gs = GeoPSServer(num_workers=1, mode="sync").start()
+    ls = GeoPSServer(num_workers=1, mode="sync",
+                     global_addr=("127.0.0.1", gs.port),
+                     global_sender_id=1000).start()
+
+    real_stop = GeoPSClient.stop_server
+
+    def slow_stop(self):
+        if self.sender_id >= 1000:  # only the local->global relay leg
+            time.sleep(1.0)         # a slow WAN: the race window, widened
+        return real_stop(self)
+
+    monkeypatch.setattr(GeoPSClient, "stop_server", slow_stop)
+
+    c = GeoPSClient(("127.0.0.1", ls.port), sender_id=0)
+    c.init("w", np.zeros(16, np.float32))
+    c.stop_server()   # ACKed BEFORE ls begins its slow forward
+    t0 = time.monotonic()
+    ls.join(timeout=20.0)
+    waited = time.monotonic() - t0
+    # join must have covered the slow forward (>= the injected delay)
+    assert waited >= 0.9, waited
+    # and the global actually received its stop: it shuts down too
+    gs.join(timeout=10.0)
+    assert gs._stops >= 1
+    c.close()
