@@ -434,11 +434,13 @@ def test_wire_stats_and_verbose_logging(monkeypatch, capfd):
 
     monkeypatch.setenv("GEOMX_PS_VERBOSE", "2")
     reset_verbose_cache()  # the level is cached off the hot path
-    # (the fixture reverts the env at teardown; the next _verbose_level
-    # call after our finally-reset re-reads it)
     try:
         _run_wire_stats_body(capfd, wire_stats)
     finally:
+        # clear the env BEFORE resetting the cache: a late ACK on a daemon
+        # thread would otherwise re-read PS_VERBOSE=2 (monkeypatch only
+        # reverts at teardown) and leak wire logs into later tests
+        monkeypatch.delenv("GEOMX_PS_VERBOSE", raising=False)
         reset_verbose_cache()
 
 
