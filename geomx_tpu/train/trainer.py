@@ -99,8 +99,15 @@ class Trainer:
             step=jnp.zeros((), jnp.int32),
             params=params, opt_state=opt_state,
             model_state=model_state, sync_state=sync_state)
+        # the replicated scalar must carry the SAME NamedSharding the
+        # compiled step emits for it: a SingleDeviceSharding here makes
+        # the second train_step/epoch-runner call a jit cache MISS (the
+        # input sharding is part of the key) — one full recompile, ~10s
+        # per process on a tunneled chip
+        from jax.sharding import NamedSharding, PartitionSpec
         return TrainState(
-            step=state.step,
+            step=jax.device_put(state.step,
+                                NamedSharding(self.mesh, PartitionSpec())),
             params=replicate_tree(state.params, self.topology, self.mesh),
             opt_state=replicate_tree(state.opt_state, self.topology, self.mesh),
             model_state=replicate_tree(state.model_state, self.topology, self.mesh),
@@ -158,8 +165,6 @@ class Trainer:
         dispatch and one scalar readback per call, instead of a host
         round trip per batch (which dominates eval wall-clock on a
         remote/tunneled chip)."""
-        params = jax.tree.map(lambda a: a[0, 0], state.params)
-        model_state = jax.tree.map(lambda a: a[0, 0], state.model_state)
         n = len(x)
         # content-fingerprint cache key (not object identity, which a
         # recycled id or in-place mutation would silently go stale on):
@@ -194,6 +199,12 @@ class Trainer:
 
             @jax.jit
             def run(params, model_state, dx, dy):
+                # copy (0, 0) selection happens IN-program: eager
+                # per-leaf slicing was ~2 host dispatches per leaf per
+                # call — hundreds of tunnel round trips per eval
+                params = jax.tree.map(lambda a: a[0, 0], params)
+                model_state = jax.tree.map(lambda a: a[0, 0], model_state)
+
                 def body(acc, i):
                     xb = jax.lax.dynamic_slice_in_dim(dx, i * b, b)
                     yb = jax.lax.dynamic_slice_in_dim(dy, i * b, b)
@@ -204,7 +215,7 @@ class Trainer:
                 return acc
 
             self._eval_sweeps[batch_size] = run
-        correct = int(run(params, model_state, dx, dy))
+        correct = int(run(state.params, state.model_state, dx, dy))
         return correct / max(n, 1)
 
     def _epoch_runner(self, loader: GeoDataLoader):

@@ -3,8 +3,10 @@ profiling, failure detection."""
 
 from geomx_tpu.utils.metrics import Measure
 from geomx_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
+from geomx_tpu.utils.compile_cache import enable_compile_cache
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
 from geomx_tpu.utils.net import free_port_blocks
 
 __all__ = ["Measure", "save_checkpoint", "load_checkpoint",
-           "HeartbeatMonitor", "free_port_blocks"]
+           "HeartbeatMonitor", "free_port_blocks",
+           "enable_compile_cache"]

@@ -1,0 +1,58 @@
+"""Persistent XLA compilation cache.
+
+On a tunneled TPU a fresh process pays 20-40s of compiles before the
+first real step; the programs themselves are stable across runs, so a
+disk cache turns every run after the first into a warm start (measured
+~6x faster process turnaround on the tunnel).  The reference amortizes
+its (much smaller) graph-bind cost inside one long-lived process — in a
+jit-compiled framework the equivalent is making compilation itself
+persistent.
+
+The cache is keyed by XLA's hash of the lowered program + compile
+options + device kind, so stale entries are never *hit*, only ignored;
+it is safe to share one directory across branches and code versions.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIRNAME = ".geomx_compile_cache"
+
+
+def enable_compile_cache(path: str | None = None,
+                         min_compile_seconds: float = 0.5) -> str | None:
+    """Turn on JAX's persistent compilation cache.
+
+    ``path``: cache directory; defaults to ``$GEOMX_COMPILE_CACHE`` or
+    ``<repo-or-cwd>/.geomx_compile_cache``.  ``GEOMX_COMPILE_CACHE=0``
+    disables and returns None.  Entries that took less than
+    ``min_compile_seconds`` to compile are not persisted (they are
+    cheaper to recompile than to stat).
+
+    Also exports the standard JAX env names so child processes (PS
+    workers launched by scripts/launch.py, bench measurement children)
+    inherit the same cache without importing this module first.
+    """
+    if path is None:
+        # only an UNSET path consults the env: an explicit path argument
+        # (the test conftest, a framework embedder) must not be vetoed
+        # by a GEOMX_COMPILE_CACHE=0 meant for the bench default
+        env = os.environ.get("GEOMX_COMPILE_CACHE", "")
+        if env == "0":
+            return None
+        path = env or os.path.join(os.getcwd(), _DEFAULT_DIRNAME)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_seconds)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # unconditional: children must land in THIS cache, even when the
+    # parent environment already pointed somewhere else
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = str(
+        min_compile_seconds)
+    os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+    return path

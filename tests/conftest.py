@@ -16,6 +16,30 @@ import jax  # noqa: E402
 # the axon TPU-tunnel plugin overrides JAX_PLATFORMS at import time; force
 # the virtual CPU mesh explicitly
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the suite's wall time is dominated by
+# CPU compiles of the training-step programs, and the programs are stable
+# across runs, so warm reruns cut minutes.  Keyed by HLO hash — stale
+# entries are simply never hit.  GEOMX_TEST_COMPILE_CACHE=0 disables;
+# any other value overrides the cache directory.
+_cc = os.environ.get("GEOMX_TEST_COMPILE_CACHE", "")
+if _cc != "0":
+    # also exports the JAX_* env names, so subprocess tests
+    # (launcher/dist_ps children) land in the same cache.  The default
+    # dir is keyed by a static environment profile (jax version +
+    # whether a platform plugin is installed): CPU AOT executables
+    # embed the writer's machine-feature flags, and writers from
+    # different environment profiles must not share entries (XLA warns
+    # "+prefer-no-scatter ... SIGILL" on mismatched loads)
+    import importlib.util
+    _prof = (f"jax{jax.__version__}-"
+             f"{'plugin' if importlib.util.find_spec('jax_plugins') else 'plain'}")
+    from geomx_tpu.utils import enable_compile_cache
+    enable_compile_cache(
+        _cc or os.path.join(os.path.dirname(__file__),
+                            ".jax_compile_cache", _prof),
+        min_compile_seconds=0.7)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
