@@ -447,6 +447,38 @@ def _microbench_kernels(peak, on_tpu: bool):
         return v * (1.0 + 1e-12 * vals[0])
     out["bsc_topk_sampled_ms"] = round(
         _slope(_sampled_step, g) * 1e3, 4)
+
+    # long-context attention: fused Pallas kernel vs the dense jnp graph
+    # (which materializes [B, H, L, L] scores+probs in HBM).  The carry
+    # perturbs q so every iteration depends on the last.
+    if on_tpu:
+        try:
+            from geomx_tpu.ops import fused_attention_supported
+            from geomx_tpu.ops.flash_attention import flash_attention
+            from geomx_tpu.parallel.ring_attention import (
+                full_attention_reference)
+            if fused_attention_supported():
+                Ba, La, Ha, Da = 4, 2048, 8, 64
+                rs = np.random.RandomState(1)
+                qa, ka, va = (jnp.asarray(
+                    rs.normal(size=(Ba, La, Ha, Da)), jnp.bfloat16)
+                    for _ in range(3))
+                alo, ahi = max(1, lo // 100), max(2, hi // 100)
+
+                def _flash_step(qc):
+                    o = flash_attention(qc, ka, va, causal=True)
+                    return qc * 0.999 + o.astype(qc.dtype) * 1e-3
+                out["attn_flash_pallas_ms"] = round(_slope(
+                    _flash_step, qa, lo=alo, hi=ahi) * 1e3, 4)
+
+                def _dense_step(qc):
+                    o = full_attention_reference(qc, ka, va, causal=True)
+                    return qc * 0.999 + o.astype(qc.dtype) * 1e-3
+                out["attn_dense_xla_ms"] = round(_slope(
+                    _dense_step, qa, lo=alo, hi=ahi) * 1e3, 4)
+                out["attn_shape"] = f"B{Ba} L{La} H{Ha} D{Da} causal bf16"
+        except Exception as e:
+            out["attn_flash_error"] = repr(e)
     return out
 
 

@@ -37,8 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from geomx_tpu.parallel.ring_attention import (full_attention_reference,
-                                               ring_attention)
+from geomx_tpu.ops.flash_attention import fused_attention
+from geomx_tpu.parallel.ring_attention import ring_attention
 from geomx_tpu.parallel.ulysses import ulysses_attention
 from geomx_tpu.topology import SP_AXIS
 
@@ -89,7 +89,10 @@ class SPAttention(nn.Module):
         elif self.sp_mode == "ulysses":
             out = ulysses_attention(q, k, v, SP_AXIS, causal=self.causal)
         elif self.sp_mode is None:
-            out = full_attention_reference(q, k, v, causal=self.causal)
+            # un-meshed path: the fused Pallas kernel on TPU (no [L, L]
+            # HBM materialization), the dense jnp reference elsewhere —
+            # fused_attention dispatches; same math to f32 tolerance
+            out = fused_attention(q, k, v, self.causal)
         else:
             raise ValueError(f"unknown sp_mode {self.sp_mode!r}")
         out = out.reshape(B, L, self.num_heads * hd)

@@ -1,4 +1,4 @@
-"""Custom TPU kernels (Pallas) for the hot compression ops.
+"""Custom TPU kernels (Pallas) for the hot ops.
 
 The reference implements its custom math as CPU loops + CUDA kernels
 (gradient_compression-inl.h, gradient_compression.cu); here the
@@ -8,12 +8,20 @@ makes one HBM round trip:
 - ``quantize_2bit``: residual += grad; threshold compare; pack 16 2-bit
   codes per int32 word; residual -= sent — one pass.
 - ``dequantize_2bit``: unpack + scale.
+- ``flash_attention`` / ``fused_attention``: online-softmax attention
+  for the long-context path — the [L, L] score matrix never reaches
+  HBM (the reference has no attention operator at all).
 
 Kernels run natively on TPU and in Pallas interpret mode elsewhere
 (tests exercise them on CPU via interpret mode).
 """
 
+from geomx_tpu.ops.flash_attention import (flash_attention,
+                                           fused_attention,
+                                           fused_attention_supported)
 from geomx_tpu.ops.twobit_pallas import (quantize_2bit, dequantize_2bit,
                                          pallas_supported)
 
-__all__ = ["quantize_2bit", "dequantize_2bit", "pallas_supported"]
+__all__ = ["quantize_2bit", "dequantize_2bit", "pallas_supported",
+           "flash_attention", "fused_attention",
+           "fused_attention_supported"]

@@ -1,0 +1,205 @@
+"""Fused flash-attention Pallas kernel.
+
+The reference has no attention operator at all (its workloads are CNNs;
+SURVEY.md §5 "long-context: absent") — this kernel backs the framework's
+first-class long-context path (`models/seq_classifier.py`,
+`parallel/ring_attention.py`) with a TPU-native fused implementation:
+one pass over KV tiles with an online softmax held in VMEM scratch, so
+the [L, L] score matrix never touches HBM.  The unfused XLA graph
+materializes scores + probabilities ([B, H, L, L] each, f32) — at
+L=4096 that is 2 x 64 MB per (batch, head) of HBM traffic this kernel
+never pays.
+
+Forward-only fusion: the backward recomputes attention with the dense
+jnp math under `jax.custom_vjp` (same cost/memory as the previous
+all-jnp path, exact same gradients).  For sequences long enough that
+the dense backward matters, ring attention shards L across the sp axis
+first — per-device blocks stay at L/n where the dense recompute is the
+right trade (flash-bwd's extra 0.5x recompute FLOPs vs one more HBM
+pass; see jax-ml flash discussions).
+
+Numerics match `parallel/ring_attention.full_attention_reference` to
+f32 tolerance (tests/test_flash_attention.py), including fully-masked
+rows (causal + padding) which produce zeros, not NaNs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # large-but-finite: -inf breaks the m-correction exp
+
+
+_LANES = 128  # m/l scratch is lane-replicated 2-D: TPU Mosaic has
+# historically rejected 1-D VMEM refs (the upstream JAX flash kernel
+# pads to (block_q, 128) for the same reason)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+               scale, block_q, block_k, num_k, kv_len, causal):
+    """Grid (BH, nq, nk), k innermost.  Blocks: q/o [1, block_q, D];
+    k/v [1, block_k, D].  Scratch m/l [block_q, LANES] (lane-replicated)
+    and acc [block_q, D] carry the online softmax across the k dim."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)      # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)      # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        rows = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = cols < kv_len                  # padded keys contribute 0
+        if causal:
+            mask = mask & (cols <= rows)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                 # [Bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)           # exp(NEG_INF-m) underflows,
+        # but a fully-masked row has m_new = NEG_INF where it would not
+        corr = jnp.exp(m_prev - m_new)        # [Bq, 1]
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # a block whose every column is in the masked future contributes
+        # nothing — skip its matmuls entirely (~half the grid at nq == nk)
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ik == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-20)  # fully-masked rows -> 0 out
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Fused attention forward: softmax(QK^T / sqrt(D)) V.
+
+    q, k, v: [B, L, H, D] (L may differ between q and k/v only via
+    padding — the kernel masks keys past k's length).  Returns [B, L, H,
+    D] in q's dtype.  Gradients flow via the dense-recompute backward of
+    :func:`fused_attention`; differentiate THAT, not this.
+    """
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / float(np.sqrt(D))
+
+    bq, bk = min(block_q, Lq), min(block_k, Lk)
+    pq, pk = (-Lq) % bq, (-Lk) % bk
+
+    def pad(x, p):
+        return jnp.pad(x, ((0, 0), (0, p), (0, 0), (0, 0))) if p else x
+
+    qp, kp, vp = pad(q, pq), pad(k, pk), pad(v, pk)
+    Lqp, Lkp = Lq + pq, Lk + pk
+    nq, nk = Lqp // bq, Lkp // bk
+
+    # [B, L, H, D] -> [B*H, L, D]: one grid row per (batch, head)
+    def heads_first(x, L):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, L, x.shape[-1])
+
+    qh, kh, vh = (heads_first(x, L) for x, L in
+                  ((qp, Lqp), (kp, Lkp), (vp, Lkp)))
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, block_q=bq, block_k=bk, num_k=nk,
+        kv_len=Lk, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lqp, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # normalizer l
+            pltpu.VMEM((bq, D), jnp.float32),       # output accumulator
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    out = out.reshape(B, H, Lqp, D).transpose(0, 2, 1, 3)
+    return out[:, :Lq]
+
+
+def fused_attention_supported() -> bool:
+    """True when the native kernel path is active: on TPU, unless the
+    GEOMX_FLASH_ATTN=0 kill-switch forces the dense fallback."""
+    import os
+    if os.environ.get("GEOMX_FLASH_ATTN", "1") == "0":
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _dense(q, k, v, causal):
+    """f32-upcast dense attention — delegates the math to the numerical
+    baseline (`full_attention_reference`), so the backward's gradients
+    match it by construction."""
+    from geomx_tpu.parallel.ring_attention import full_attention_reference
+    return full_attention_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=causal).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_attention(q, k, v, causal: bool = False,
+                    interpret: bool = False):
+    """Differentiable attention with platform dispatch built in: the
+    Pallas kernel forward on TPU (or under ``interpret=True``), the
+    dense jnp reference elsewhere — callers never gate on platform.
+    Backward always dense-recomputes (exact reference gradients)."""
+    if interpret or fused_attention_supported():
+        return flash_attention(q, k, v, causal=causal,
+                               interpret=interpret)
+    return _dense(q, k, v, causal)
+
+
+def _fused_fwd(q, k, v, causal, interpret):
+    return fused_attention(q, k, v, causal, interpret), (q, k, v)
+
+
+def _fused_bwd(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _dense(q_, k_, v_, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+fused_attention.defvjp(_fused_fwd, _fused_bwd)
