@@ -31,6 +31,16 @@ def run(extra_args=(), config_fn=lambda a: {}, sync_default="fsa"):
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    elif jax.devices()[0].platform == "tpu":
+        # persistent compile cache: repeat demo runs start warm instead
+        # of paying 20-40s of tunnel compiles (TPU-only — heterogeneous
+        # CPU writers must not share AOT entries).  Pin the repo-local
+        # dir so every launch cwd shares one cache (same as bench.py).
+        from geomx_tpu.utils import enable_compile_cache
+        enable_compile_cache(
+            path=None if os.environ.get("GEOMX_COMPILE_CACHE")
+            else os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".geomx_compile_cache"))
 
     from geomx_tpu import GeoConfig, HiPSTopology
     from geomx_tpu.data import load_dataset
