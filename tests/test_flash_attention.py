@@ -90,3 +90,20 @@ def test_fully_masked_rows_are_zero_not_nan():
     out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
                           interpret=True)
     assert not bool(jnp.any(jnp.isnan(out)))
+
+
+def test_lowers_to_tpu_mosaic_without_a_device():
+    """Cross-platform export runs the Pallas->Mosaic lowering pass for
+    the TPU target on any host — catching tiling/shape rejections (1-D
+    scratch, iota rank, pl.when predicates) without TPU hardware.  Only
+    Mosaic->binary compilation remains device-side."""
+    from jax import export as jax_export
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.float32)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    exp = jax_export.export(jax.jit(f), platforms=("tpu",))(q, q, q)
+    assert "tpu_custom_call" in exp.mlir_module()
