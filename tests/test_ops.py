@@ -53,3 +53,21 @@ def test_pallas_compressor_matches_jnp_path(topo2x4, mesh2x4):
         TwoBitCompressor(0.5, use_pallas=True, pallas_interpret=True),
         g, topo2x4, mesh2x4)
     np.testing.assert_allclose(out_p, out_j, atol=1e-6)
+
+
+def test_twobit_kernels_lower_to_tpu_mosaic_without_a_device():
+    """Same guard as the flash kernel's: cross-platform export runs the
+    Pallas->Mosaic lowering pass for TPU on any host, so a future edit
+    that breaks tiling/packing surfaces in the CPU suite, not on chip."""
+    import jax
+    from jax import export as jax_export
+
+    g = jnp.asarray(np.random.RandomState(0).randn(8192), jnp.float32)
+    r = jnp.zeros((8192,), jnp.float32)
+
+    def f(g, r):
+        packed, newr = quantize_2bit(g, r, 0.5)
+        return dequantize_2bit(packed, 8192, 0.5), newr
+
+    exp = jax_export.export(jax.jit(f), platforms=("tpu",))(g, r)
+    assert "tpu_custom_call" in exp.mlir_module()
