@@ -1,0 +1,156 @@
+"""Fused flash-accumulation block for ring attention.
+
+One ring hop updates the streaming-softmax state (m, l, o) with the
+attention of the local Q block against the K/V block currently held —
+`ring_attention._block` in jnp.  This module is the Pallas version of
+that single hop: carries come IN as arrays and go OUT updated, so the
+ring's `ppermute` loop composes hops across devices while each hop's
+inner tiles never materialize the [Lq, Lk] score matrix in HBM.
+
+Gradients: `fused_block` carries a `jax.custom_vjp` whose backward is
+the VJP of the jnp `_block` (exact same math, recomputed) — the ring's
+`fori_loop`/scan autodiff works unchanged.
+
+Mask modes (static): 0 = attend to the whole K/V block, 1 = causal
+diagonal block (lower-triangular within the block).  The "skip" case of
+a causal ring hop never calls the kernel at all.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128  # m/l are lane-replicated 2-D (TPU Mosaic tiling)
+
+
+def _hop_kernel(q_ref, k_ref, v_ref, m_in, l_in, o_in,
+                m_out, l_out, o_out, *, scale, block_q, block_k, diag):
+    """Grid (BH, nq, nk), k innermost.  q/o blocks [1, bq, D]; k/v
+    [1, bk, D]; m/l blocks [1, bq, LANES] (lane-replicated).  The
+    incoming state seeds the accumulation at ik == 0; the final tile
+    writes the updated state out — o stays UN-normalized (o_new =
+    o*corr + p@v), exactly like the jnp `_block`."""
+    iq = pl.program_id(1)  # hoisted: program_id cannot be called inside
+    ik = pl.program_id(2)  # a pl.when body on the interpret path
+
+    @pl.when(ik == 0)
+    def _seed():
+        m_out[:] = m_in[:]
+        l_out[:] = l_in[:]
+        o_out[:] = o_in[:]
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)      # [bq, D]
+        k = k_ref[0].astype(jnp.float32)      # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if diag:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = cols <= rows
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_out[0, :, :1]              # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if diag:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_out[0, :, :1] * corr + jnp.sum(p, axis=-1,
+                                                 keepdims=True)
+        o_out[0] = o_out[0] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_out[0] = jnp.broadcast_to(m_new, (block_q, _LANES))
+        l_out[0] = jnp.broadcast_to(l_new, (block_q, _LANES))
+
+    if diag:
+        # future-only tiles of the diagonal block contribute nothing
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_accumulate)
+    else:
+        _accumulate()
+
+
+def _hop_pallas(q, k, v, m, l, o, scale, diag, block, interpret):
+    """q [BH, Lq, D]; k, v [BH, Lk, D]; m, l [BH, Lq]; o [BH, Lq, D]
+    (all f32).  Returns updated (m, l, o)."""
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    bq, bk = min(block, Lq), min(block, Lk)
+    if Lq % bq or Lk % bk:
+        raise ValueError(f"ring block sizes must tile L ({Lq}, {Lk}) "
+                         f"by {block}")
+    nq, nk = Lq // bq, Lk // bk
+    m2 = jnp.broadcast_to(m[..., None], (BH, Lq, _LANES))
+    l2 = jnp.broadcast_to(l[..., None], (BH, Lq, _LANES))
+
+    kernel = functools.partial(_hop_kernel, scale=scale, block_q=bq,
+                               block_k=bk, diag=diag)
+    qspec = pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0))
+    kspec = pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0))
+    mspec = pl.BlockSpec((1, bq, _LANES), lambda bh, iq, ik: (bh, iq, 0))
+    m_o, l_o, o_o = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[qspec, kspec, kspec, mspec, mspec, qspec],
+        out_specs=[mspec, mspec, qspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, m2, l2, o)
+    return m_o[..., 0], l_o[..., 0], o_o
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def fused_block(q, k, v, m, l, o, scale, diag, block, interpret):
+    """Pallas flash hop with the jnp `_block`'s exact gradient.
+
+    Layouts match `ring_attention._block`: q/o [B, Lq, H, D], k/v
+    [B, Lk, H, D], m/l [B, H, Lq]; all f32; returns (m, l, o) updated.
+    """
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+
+    def bh(x, L):  # [B, L, H, D] -> [B*H, L, D]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+
+    m_o, l_o, o_o = _hop_pallas(
+        bh(q, Lq), bh(k, Lk), bh(v, Lk),
+        m.reshape(B * H, Lq), l.reshape(B * H, Lq), bh(o, Lq),
+        scale, diag, block, interpret)
+    return (m_o.reshape(B, H, Lq), l_o.reshape(B, H, Lq),
+            o_o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3))
+
+
+def _jnp_block(q, k, v, m, l, o, scale, diag):
+    from geomx_tpu.parallel.ring_attention import _block
+    mask = (jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+            if diag else None)
+    return _block(q, k, v, m, l, o, scale, mask)
+
+
+def _fused_fwd(q, k, v, m, l, o, scale, diag, block, interpret):
+    return (fused_block(q, k, v, m, l, o, scale, diag, block, interpret),
+            (q, k, v, m, l, o))
+
+
+def _fused_bwd(scale, diag, block, interpret, res, g):
+    q, k, v, m, l, o = res
+    _, vjp = jax.vjp(
+        lambda *a: _jnp_block(*a, scale, diag), q, k, v, m, l, o)
+    return vjp(g)
+
+
+fused_block.defvjp(_fused_fwd, _fused_bwd)

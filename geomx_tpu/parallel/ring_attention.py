@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -49,18 +50,41 @@ def _block(q, k, v, m, l, o, scale, mask):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str, causal: bool = False) -> jax.Array:
+                   axis_name: str, causal: bool = False,
+                   use_fused: Optional[bool] = None,
+                   _interpret: bool = False) -> jax.Array:
     """Sequence-parallel attention; call inside shard_map.
 
     q, k, v: local blocks [B, L_local, H, D] (sequence sharded over
     ``axis_name``).  Returns the local output block [B, L_local, H, D].
     With ``causal=True`` positions attend only to earlier global positions
     (block-wise masking; within-block mask on the diagonal block).
+
+    ``use_fused``: compute each hop with the fused Pallas flash block
+    (`parallel/_fused_block.py`) instead of the jnp streaming block —
+    same math, but the per-hop [Lq, Lk] score matrix never reaches HBM.
+    Default: on TPU when the local length tiles (GEOMX_FLASH_ATTN=0
+    disables); ``_interpret=True`` runs the kernel in Pallas interpret
+    mode (CPU equivalence tests).
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+
+    hop_block = min(128, Lq)
+    if use_fused is None:
+        from geomx_tpu.ops.flash_attention import fused_attention_supported
+        # auto-enable only on Mosaic-friendly tilings: the hop block must
+        # tile L_local AND be sublane-aligned (f32 tile is 8 sublanes),
+        # and the head dim lane-aligned — otherwise keep the jnp hop,
+        # which works for any shape (explicit use_fused=True overrides)
+        use_fused = (fused_attention_supported()
+                     and Lq % hop_block == 0 and hop_block % 8 == 0
+                     and D % 8 == 0)
+    if use_fused and Lq % hop_block:
+        raise ValueError(f"fused ring hop needs L_local ({Lq}) divisible "
+                         f"by the hop block ({hop_block})")
 
     m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Lq), jnp.float32)
@@ -68,6 +92,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     qf = q.astype(jnp.float32)
     tri = jnp.tril(jnp.ones((Lq, Lq), bool))
+
+    if use_fused:
+        from geomx_tpu.parallel._fused_block import fused_block
+
+        def hop(m_, l_, o_, kk, vv, diag):
+            return fused_block(qf, kk, vv, m_, l_, o_, float(1.0 /
+                               np.sqrt(D)), diag, hop_block, _interpret)
+    else:
+        def hop(m_, l_, o_, kk, vv, diag):
+            return _block(qf, kk, vv, m_, l_, o_, scale,
+                          tri if diag else None)
 
     def body(step, carry):
         m, l, o, kk, vv = carry
@@ -77,10 +112,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             # diagonal block: lower-triangular; earlier blocks: full;
             # later blocks: empty
             def masked(m_, l_, o_):
-                return _block(qf, kk, vv, m_, l_, o_, scale, tri)
+                return hop(m_, l_, o_, kk, vv, True)
 
             def full(m_, l_, o_):
-                return _block(qf, kk, vv, m_, l_, o_, scale, None)
+                return hop(m_, l_, o_, kk, vv, False)
 
             def skip(m_, l_, o_):
                 return m_, l_, o_
@@ -90,7 +125,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 lambda m_, l_, o_: lax.cond(src < idx, full, skip, m_, l_, o_),
                 m, l, o)
         else:
-            m, l, o = _block(qf, kk, vv, m, l, o, scale, None)
+            m, l, o = hop(m, l, o, kk, vv, False)
         # rotate K/V around the ring (skip after the final block)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kk = lax.ppermute(kk, axis_name, perm)

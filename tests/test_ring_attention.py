@@ -134,3 +134,150 @@ def test_ulysses_streaming_blocks_and_padding(causal):
     ref = full_attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas hop (parallel/_fused_block.py), interpret mode on CPU
+# ---------------------------------------------------------------------------
+
+def _rand_state(rng, B, Lq, H, D, hops_done):
+    """A mid-ring (m, l, o) state: -inf/zeros before any hop, realistic
+    running values after one."""
+    if not hops_done:
+        return (jnp.full((B, H, Lq), -jnp.inf, jnp.float32),
+                jnp.zeros((B, H, Lq), jnp.float32),
+                jnp.zeros((B, Lq, H, D), jnp.float32))
+    m = jnp.asarray(rng.normal(size=(B, H, Lq)).astype(np.float32))
+    l = jnp.asarray(rng.uniform(0.5, 2.0, size=(B, H, Lq))
+                    .astype(np.float32))
+    o = jnp.asarray(rng.normal(size=(B, Lq, H, D)).astype(np.float32))
+    return m, l, o
+
+
+@pytest.mark.parametrize("diag", [False, True])
+@pytest.mark.parametrize("hops_done", [0, 1])
+def test_fused_block_matches_jnp_block(diag, hops_done):
+    from geomx_tpu.parallel._fused_block import fused_block
+    from geomx_tpu.parallel.ring_attention import _block
+
+    rng = np.random.RandomState(5)
+    B, Lq, Lk, H, D = 2, 32, 32, 2, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, Lq, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    m, l, o = _rand_state(rng, B, Lq, H, D, hops_done)
+    scale = 1.0 / np.sqrt(D)
+
+    mask = jnp.tril(jnp.ones((Lq, Lk), bool)) if diag else None
+    m_r, l_r, o_r = _block(q, k, v, m, l, o, scale, mask)
+    m_f, l_f, o_f = fused_block(q, k, v, m, l, o, scale, diag, 16, True)
+    np.testing.assert_allclose(np.asarray(m_f), np.asarray(m_r),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(l_f), np.asarray(l_r),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_r),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_fused_block_gradients_match_jnp_block():
+    from geomx_tpu.parallel._fused_block import fused_block
+    from geomx_tpu.parallel.ring_attention import _block
+
+    rng = np.random.RandomState(6)
+    B, Lq, H, D = 1, 16, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, Lq, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    m, l, o = _rand_state(rng, B, Lq, H, D, 1)
+    scale = 1.0 / np.sqrt(D)
+
+    def loss_f(q, k, v):
+        mf, lf, of = fused_block(q, k, v, m, l, o, scale, True, 16, True)
+        return jnp.sum(of ** 2) + jnp.sum(lf) + jnp.sum(mf)
+
+    def loss_r(q, k, v):
+        mask = jnp.tril(jnp.ones((Lq, Lq), bool))
+        mr, lr, orr = _block(q, k, v, m, l, o, scale, mask)
+        return jnp.sum(orr ** 2) + jnp.sum(lr) + jnp.sum(mr)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_ring_matches_jnp_ring(causal):
+    """The full ring with fused Pallas hops (interpret mode) against the
+    jnp-hop ring AND the dense reference — inside shard_map, gradients
+    included via the training-path test below."""
+    rng = np.random.RandomState(7)
+    B, L, H, D = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, L, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    devs = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    spec = P(None, "sp", None, None)
+
+    def run(fused):
+        def f(ql, kl, vl):
+            return ring_attention(ql, kl, vl, "sp", causal=causal,
+                                  use_fused=fused, _interpret=fused)
+        fn = shard_map_compat(f, mesh, in_specs=(spec, spec, spec),
+                              out_specs=spec)
+        return jax.jit(fn)(q, k, v)
+
+    out_f = run(True)
+    out_j = run(False)
+    ref = full_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_j),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_hop_lowers_to_tpu_mosaic_without_a_device():
+    from jax import export as jax_export
+
+    from geomx_tpu.parallel._fused_block import fused_block
+
+    rng = np.random.RandomState(8)
+    B, Lq, H, D = 2, 256, 4, 64
+    q, k, v = (jnp.asarray(rng.normal(size=(B, Lq, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    m = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Lq), jnp.float32)
+    o = jnp.zeros((B, Lq, H, D), jnp.float32)
+
+    def f(q, k, v, m, l, o):
+        return fused_block(q, k, v, m, l, o, 1.0 / np.sqrt(D), True,
+                           128, False)
+
+    exp = jax_export.export(jax.jit(f), platforms=("tpu",))(q, k, v, m, l, o)
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_fused_ring_gradients_match_jnp_ring():
+    """Autodiff through fori_loop -> lax.cond -> custom_vjp hop must
+    equal the all-jnp ring's gradients."""
+    rng = np.random.RandomState(9)
+    B, L, H, D = 1, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, L, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    devs = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    spec = P(None, "sp", None, None)
+
+    def make_loss(fused):
+        def f(ql, kl, vl):
+            out = ring_attention(ql, kl, vl, "sp", causal=True,
+                                 use_fused=fused, _interpret=fused)
+            return jnp.sum(out ** 2, keepdims=True).reshape(1, 1, 1, 1)
+        fn = shard_map_compat(f, mesh, in_specs=(spec, spec, spec),
+                              out_specs=P(None, "sp", None, None))
+        return lambda q, k, v: jnp.sum(fn(q, k, v))
+
+    gf = jax.grad(make_loss(True), argnums=(0, 1, 2))(q, k, v)
+    gj = jax.grad(make_loss(False), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gj):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
