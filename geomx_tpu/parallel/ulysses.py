@@ -19,6 +19,8 @@ length.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -67,7 +69,9 @@ def _streaming_attention(q, k, v, causal: bool,
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                      axis_name: str, causal: bool = False) -> jax.Array:
+                      axis_name: str, causal: bool = False,
+                      use_fused: Optional[bool] = None,
+                      _interpret: bool = False) -> jax.Array:
     """Sequence-parallel attention via head/sequence all-to-all
     re-sharding; call inside shard_map.
 
@@ -75,12 +79,26 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``axis_name``); requires H % axis_size == 0.  Returns the local
     output block [B, L_local, H, D], numerically identical to dense
     attention over the full sequence.
+
+    ``use_fused``: run the on-device attention with the fused Pallas
+    flash kernel via `ops.fused_attention` (default: on TPU with a
+    lane-aligned head dim; GEOMX_FLASH_ATTN=0 disables).  The forward
+    then never materializes the [L, L] scores; the BACKWARD is
+    fused_attention's dense recompute — O(L^2) score memory, the same
+    order autodiff of the streaming path costs in scan residuals (a
+    flash backward kernel is the real long-L fix; until then the
+    backward bound is unchanged either way).
     """
     n = lax.psum(1, axis_name)
     B, Lq, H, D = q.shape
     if H % n != 0:
         raise ValueError(f"ulysses needs heads ({H}) divisible by the "
                          f"sequence axis size ({n})")
+    if use_fused is None:
+        from geomx_tpu.ops.flash_attention import fused_attention_supported
+        # D alignment mirrors ring_attention's gate: Mosaic needs the
+        # head dim sublane/lane-aligned (flash_attention pads only L)
+        use_fused = fused_attention_supported() and D % 8 == 0
 
     # ONE all_to_all for q/k/v stacked: [3, B, L/n, H, D] -> [3, B, L,
     # H/n, D] — each device trades its sequence shard of every head for
@@ -88,7 +106,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # in device order = global sequence order)
     qkv = lax.all_to_all(jnp.stack([q, k, v]), axis_name,
                          split_axis=3, concat_axis=2, tiled=True)
-    out = _streaming_attention(qkv[0], qkv[1], qkv[2], causal)
+    if use_fused:
+        from geomx_tpu.ops.flash_attention import fused_attention
+        out = fused_attention(qkv[0], qkv[1], qkv[2], causal, _interpret)
+    else:
+        out = _streaming_attention(qkv[0], qkv[1], qkv[2], causal)
     # downcast BEFORE the return trip: all_to_all is pure data movement,
     # so casting first is bit-identical and halves the wire bytes for
     # sub-f32 activations.  [B, L, H/n, D] -> [B, L/n, H, D]

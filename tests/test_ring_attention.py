@@ -281,3 +281,59 @@ def test_fused_ring_gradients_match_jnp_ring():
     for a, b in zip(gf, gj):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_ulysses_matches_jnp_ulysses(causal):
+    from geomx_tpu.parallel.ulysses import ulysses_attention
+
+    rng = np.random.RandomState(10)
+    B, L, H, D = 2, 64, 4, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, L, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    devs = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    spec = P(None, "sp", None, None)
+
+    def run(fused):
+        def f(ql, kl, vl):
+            return ulysses_attention(ql, kl, vl, "sp", causal=causal,
+                                     use_fused=fused, _interpret=fused)
+        fn = shard_map_compat(f, mesh, in_specs=(spec, spec, spec),
+                              out_specs=spec)
+        return jax.jit(fn)(q, k, v)
+
+    out_f = run(True)
+    out_j = run(False)
+    ref = full_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_j),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_ulysses_gradients_match_jnp():
+    from geomx_tpu.parallel.ulysses import ulysses_attention
+
+    rng = np.random.RandomState(11)
+    B, L, H, D = 1, 32, 4, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, L, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    devs = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    spec = P(None, "sp", None, None)
+
+    def make_loss(fused):
+        def f(ql, kl, vl):
+            out = ulysses_attention(ql, kl, vl, "sp", causal=True,
+                                    use_fused=fused, _interpret=fused)
+            return jnp.sum(out ** 2, keepdims=True).reshape(1, 1, 1, 1)
+        fn = shard_map_compat(f, mesh, in_specs=(spec, spec, spec),
+                              out_specs=P(None, "sp", None, None))
+        return lambda q, k, v: jnp.sum(fn(q, k, v))
+
+    gf = jax.grad(make_loss(True), argnums=(0, 1, 2))(q, k, v)
+    gj = jax.grad(make_loss(False), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gj):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
