@@ -81,13 +81,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     attention over the full sequence.
 
     ``use_fused``: run the on-device attention with the fused Pallas
-    flash kernel via `ops.fused_attention` (default: on TPU with a
-    lane-aligned head dim; GEOMX_FLASH_ATTN=0 disables).  The forward
-    then never materializes the [L, L] scores; the BACKWARD is
-    fused_attention's dense recompute — O(L^2) score memory, the same
-    order autodiff of the streaming path costs in scan residuals (a
-    flash backward kernel is the real long-L fix; until then the
-    backward bound is unchanged either way).
+    flash kernels via `ops.fused_attention` (default: on TPU with a
+    lane-aligned head dim; GEOMX_FLASH_ATTN=0 disables).  Flash in
+    BOTH directions: the backward recomputes p per tile from the
+    forward's logsumexp (`ops.flash_attention_bwd`), so the [L, L]
+    scores never exist in HBM — unlike autodiff of the streaming jnp
+    path, whose scan residuals total O(L^2).
     """
     n = lax.psum(1, axis_name)
     B, Lq, H, D = q.shape
