@@ -477,6 +477,37 @@ def _microbench_kernels(peak, on_tpu: bool):
                 out["attn_dense_xla_ms"] = round(_slope(
                     _dense_step, qa, lo=alo, hi=ahi) * 1e3, 4)
                 out["attn_shape"] = f"B{Ba} L{La} H{Ha} D{Da} causal bf16"
+
+                # gradient path: flash fwd+bwd kernels vs dense
+                # autodiff.  BOTH differentiate w.r.t. (q, k, v) and
+                # fold all three grads into the carry — grad w.r.t. q
+                # alone would let XLA prune the dense path's dk/dv work
+                # while the opaque flash bwd always computes all three
+                # (the unfair-comparison class the 2-bit bench fixed)
+                from geomx_tpu.ops import fused_attention
+
+                def _flash_grad_step(qc):
+                    gq, gk, gv = jax.grad(
+                        lambda qq, kk, vv: jnp.sum(
+                            fused_attention(qq, kk, vv, True, False)
+                            .astype(jnp.float32)),
+                        argnums=(0, 1, 2))(qc, ka, va)
+                    return (qc * 0.999 - (gq + gk + gv)
+                            .astype(qc.dtype) * 1e-6)
+                out["attn_flash_grad_ms"] = round(_slope(
+                    _flash_grad_step, qa, lo=alo, hi=ahi) * 1e3, 4)
+
+                def _dense_grad_step(qc):
+                    gq, gk, gv = jax.grad(
+                        lambda qq, kk, vv: jnp.sum(
+                            full_attention_reference(qq, kk, vv,
+                                                     causal=True)
+                            .astype(jnp.float32)),
+                        argnums=(0, 1, 2))(qc, ka, va)
+                    return (qc * 0.999 - (gq + gk + gv)
+                            .astype(qc.dtype) * 1e-6)
+                out["attn_dense_grad_ms"] = round(_slope(
+                    _dense_grad_step, qa, lo=alo, hi=ahi) * 1e3, 4)
         except Exception as e:
             out["attn_flash_error"] = repr(e)
     return out
