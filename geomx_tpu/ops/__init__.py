@@ -17,11 +17,14 @@ Kernels run natively on TPU and in Pallas interpret mode elsewhere
 """
 
 from geomx_tpu.ops.flash_attention import (flash_attention,
+                                           flash_attention_bwd,
+                                           flash_attention_with_lse,
                                            fused_attention,
                                            fused_attention_supported)
 from geomx_tpu.ops.twobit_pallas import (quantize_2bit, dequantize_2bit,
                                          pallas_supported)
 
 __all__ = ["quantize_2bit", "dequantize_2bit", "pallas_supported",
-           "flash_attention", "fused_attention",
+           "flash_attention", "flash_attention_bwd",
+           "flash_attention_with_lse", "fused_attention",
            "fused_attention_supported"]
