@@ -31,6 +31,13 @@ on one such GPU, so vs_baseline > 1.0 means one TPU chip outruns one
 reference GPU.  MFU is reported alongside as the self-grounding number
 (measured model FLOPs / chip peak bf16 FLOPs).
 
+Micro-modes:
+  bench.py --compare-bucketing [--model=resnet20]
+      One JSON line comparing the per-leaf vs fused-bucket dc-tier paths
+      for each compression spec on the seed model: collective launches
+      per step (counted in the traced jaxpr), wire bytes, and per-bucket
+      payloads.  CPU, seconds, no TPU needed.
+
 Env knobs:
   GEOMX_BENCH_PLATFORM=cpu   debug on the host CPU (tiny shapes)
   GEOMX_BENCH_BATCH          per-chip batch (default 2048; 256 on cpu)
@@ -834,6 +841,114 @@ def child_main():
 
 
 # --------------------------------------------------------------------------
+# --compare-bucketing: per-leaf vs fused-bucket communication accounting
+# --------------------------------------------------------------------------
+
+_COLLECTIVE_PRIMS = {"all_gather", "all_gather_invariant", "psum", "psum2",
+                     "all_to_all", "ppermute", "psum_scatter",
+                     "reduce_scatter"}
+
+
+def _count_collectives(jaxpr) -> int:
+    """Count collective primitives in a (closed) jaxpr, recursing into
+    nested jaxprs (shard_map body, pjit calls, cond branches, scans)."""
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    count = 0
+    for eqn in core.eqns:
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            count += 1
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    count += _count_collectives(sub)
+    return count
+
+
+def _compare_bucketing(model_name: str = "resnet20",
+                       specs=("none", "fp16", "2bit,0.5", "bsc,0.01",
+                              "mpq,0.01"),
+                       bucket_bytes=None):
+    """The ISSUE's acceptance measurement: for the seed model config,
+    trace each compressor's dc-tier all-reduce on a 2-party mesh both
+    per-leaf and bucketed, and count the collective launches actually in
+    the jaxpr plus the wire bytes each path accounts.  Runs on CPU — the
+    jaxpr and the accounting are platform-independent."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from geomx_tpu.compression import BucketedCompressor, get_compressor
+    from geomx_tpu.compression.bucketing import (DEFAULT_BUCKET_BYTES,
+                                                 _resolve_bucket_bytes)
+    from geomx_tpu.models import get_model
+    from geomx_tpu.parallel.collectives import shard_map_compat
+
+    bucket_bytes = _resolve_bucket_bytes(bucket_bytes)
+    if bucket_bytes <= 0:  # the compare mode exists to measure bucketing;
+        bucket_bytes = DEFAULT_BUCKET_BYTES  # a 0 opt-out doesn't apply here
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "compare-bucketing needs >= 2 devices for the dc axis (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+    mesh = Mesh(np.array(devs[:2]), ("dc",))
+
+    model = get_model(model_name, num_classes=10)
+    sample = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    params = jax.jit(lambda r, x: model.init(r, x, train=False))(
+        jax.random.PRNGKey(0), sample)["params"]
+    leaves = jax.tree.leaves(params)
+    dense_fp32 = sum(l.size * 4 for l in leaves)
+
+    def trace_collectives(comp):
+        state = comp.init_state(params)
+
+        def f(gs, ss):
+            g = jax.tree.map(lambda a: a[0], gs)
+            s = jax.tree.map(lambda a: a[0], ss)
+            out, s2 = comp.allreduce(g, s, "dc", 2)
+            return (jax.tree.map(lambda a: a[None], out),
+                    jax.tree.map(lambda a: a[None], s2))
+
+        fn = shard_map_compat(f, mesh, in_specs=(P("dc"), P("dc")),
+                              out_specs=(P("dc"), P("dc")))
+        stack = lambda t: jax.tree.map(lambda a: jnp.stack([a, a]), t)
+        return _count_collectives(jax.make_jaxpr(fn)(stack(params),
+                                                     stack(state)))
+
+    out = {"mode": "compare_bucketing", "model": model_name,
+           "num_leaves": len(leaves),
+           "total_params": int(sum(l.size for l in leaves)),
+           "dense_fp32_bytes": dense_fp32,
+           "bucket_bytes": bucket_bytes, "specs": {}}
+    for spec in specs:
+        per_leaf = get_compressor(spec)
+        bucketed = BucketedCompressor(get_compressor(spec), bucket_bytes)
+        rec = {
+            "per_leaf": {"collectives": trace_collectives(per_leaf),
+                         "wire_bytes": int(per_leaf.wire_bytes(params))},
+            "bucketed": {"collectives": trace_collectives(bucketed),
+                         "num_buckets": len(bucketed.init_state(params)),
+                         "wire_bytes": int(bucketed.wire_bytes(params)),
+                         "buckets": bucketed.bucket_report(params)},
+        }
+        rec["collective_reduction"] = (
+            rec["per_leaf"]["collectives"] / max(1, rec["bucketed"]["collectives"]))
+        out["specs"][spec] = rec
+    return out
+
+
+def compare_bucketing_main(argv):
+    model = "resnet20"
+    for a in argv:
+        if a.startswith("--model="):
+            model = a.split("=", 1)[1]
+    result = _compare_bucketing(model_name=model)
+    _emit(result)
+
+
+# --------------------------------------------------------------------------
 # parent: watchdog + single-line aggregation
 # --------------------------------------------------------------------------
 
@@ -1124,7 +1239,18 @@ def parent_main():
 
 
 def main():
-    if os.environ.get("GEOMX_BENCH_CHILD") == "1":
+    if "--compare-bucketing" in sys.argv:
+        # accounting micro-mode, not a perf mode: runs in-process on the
+        # CPU backend with a 2-device virtual mesh (env must be set
+        # before the first jax import — bench.py imports jax lazily)
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2").strip()
+        compare_bucketing_main(sys.argv[1:])
+    elif os.environ.get("GEOMX_BENCH_CHILD") == "1":
         child_main()
     else:
         parent_main()

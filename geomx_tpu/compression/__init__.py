@@ -17,6 +17,9 @@ from geomx_tpu.compression.fp16 import FP16Compressor
 from geomx_tpu.compression.twobit import TwoBitCompressor
 from geomx_tpu.compression.bisparse import BiSparseCompressor
 from geomx_tpu.compression.mpq import MPQCompressor
+from geomx_tpu.compression.bucketing import (BucketedCompressor,
+                                             GradientBucketer,
+                                             maybe_bucketed)
 
 __all__ = [
     "Compressor",
@@ -25,5 +28,8 @@ __all__ = [
     "TwoBitCompressor",
     "BiSparseCompressor",
     "MPQCompressor",
+    "BucketedCompressor",
+    "GradientBucketer",
+    "maybe_bucketed",
     "get_compressor",
 ]

@@ -6,6 +6,12 @@ kvstore_dist_server.h:183) are transmitted as fp16; larger tensors go
 through Bi-Sparse sparsification.  The split is static per tensor, so it
 maps cleanly onto XLA's static shapes: each pytree leaf is routed to one
 sub-compressor at trace time.
+
+Under the bucketed communication engine (compression/bucketing.py, the
+dc-tier default) the "tensor" MPQ routes is a fused flat *bucket*: the
+small-vs-large split happens at bucket granularity, so a bucket of many
+small leaves crosses ``size_lower_bound`` as one tensor and takes the
+sparse path its members would each have missed.
 """
 
 from __future__ import annotations

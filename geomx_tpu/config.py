@@ -70,6 +70,12 @@ class GeoConfig:
     #  src/kvstore/kvstore_dist_server.h:183; demo uses 200000)
     size_lower_bound: int = 200_000
 
+    # ---- bucketed dc-tier communication (compression/bucketing.py):
+    # gradient leaves fuse into flat fp32 buckets of ~this many bytes, one
+    # compressed collective per bucket instead of per leaf; 0 restores the
+    # per-leaf path
+    bucket_bytes: int = 4 * 1024 * 1024
+
     # ---- MultiGPS parameter sharding
     # tensors >= this many elements are sharded across the global-server axis
     # (reference MXNET_KVSTORE_BIGARRAY_BOUND, src/kvstore/kvstore_dist.h:69)
@@ -118,6 +124,8 @@ class GeoConfig:
             size_lower_bound=_env(
                 ["GEOMX_SIZE_LOWER_BOUND", "MXNET_KVSTORE_SIZE_LOWER_BOUND"],
                 200_000, int),
+            bucket_bytes=_env(["GEOMX_BUCKET_BYTES"], 4 * 1024 * 1024,
+                              lambda s: int(float(s))),
             bigarray_bound=_env(
                 ["GEOMX_BIGARRAY_BOUND", "MXNET_KVSTORE_BIGARRAY_BOUND"],
                 1_000_000, int),

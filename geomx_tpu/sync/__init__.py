@@ -30,15 +30,17 @@ def get_sync_algorithm(cfg, compressor=None):
                              k=cfg.dgt_k, alpha=cfg.dgt_contri_alpha,
                              channels=cfg.udp_channel_num)
     mode = cfg.sync_mode.lower()
+    bucket_bytes = getattr(cfg, "bucket_bytes", None)
     if mode in ("fsa", "dist_sync", "sync"):
-        return FSA(dc_compressor=comp)
+        return FSA(dc_compressor=comp, bucket_bytes=bucket_bytes)
     if mode in ("mixed", "dist_async", "async"):
         # DCASGD compensation is opt-in (reference: --dcasgd flag selects it;
         # plain --mixed-sync runs the uncompensated optimizer)
         lam = cfg.dcasgd_lambda if getattr(cfg, "dcasgd", False) else 0.0
         return MixedSync(dc_compressor=comp,
                          pull_interval=cfg.mixed_pull_interval,
-                         dcasgd_lambda=lam)
+                         dcasgd_lambda=lam,
+                         bucket_bytes=bucket_bytes)
     if mode == "hfa":
         return HFA(k1=cfg.hfa_k1, k2=cfg.hfa_k2, dc_compressor=comp)
     raise ValueError(f"Unknown sync mode: {cfg.sync_mode!r}")

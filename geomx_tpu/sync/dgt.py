@@ -60,6 +60,9 @@ from geomx_tpu.compression.base import Compressor, NoCompressor
 
 class DGTCompressor(Compressor):
     name = "dgt"
+    # the tree-level allreduce below already fuses the whole gradient into
+    # one flat buffer — the bucketing default must not wrap it again
+    fuses_tree = True
 
     def __init__(self, inner: Optional[Compressor] = None,
                  block_elems: int = 1024, k: float = 0.5, alpha: float = 0.3,

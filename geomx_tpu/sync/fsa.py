@@ -15,8 +15,11 @@ followed by an optimizer step applied identically on every device, which
 keeps parameters replicated without any explicit pull.  The dc-tier
 compressor slot is where Bi-Sparse / FP16 / MPQ / 2-bit plug in, exactly
 the hop they compress in the reference (local server -> global server).
-An optional worker-tier compressor covers the reference's intra-DC fp16
-mode.
+By default the dc compressor is wrapped in the bucketed communication
+engine (compression/bucketing.py): the gradient tree fuses into a few
+flat fp32 buckets, one compressed collective each, instead of one
+collective per leaf (GEOMX_BUCKET_BYTES=0 opts out).  An optional
+worker-tier compressor covers the reference's intra-DC fp16 mode.
 """
 
 from __future__ import annotations
@@ -35,8 +38,17 @@ class FSA(SyncAlgorithm):
     name = "fsa"
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
-                 worker_compressor: Optional[Compressor] = None):
-        self.dc_compressor = dc_compressor or NoCompressor()
+                 worker_compressor: Optional[Compressor] = None,
+                 bucket_bytes: Optional[int] = None):
+        from geomx_tpu.compression.bucketing import maybe_bucketed
+        # the dc tier pays a fixed DCN round trip per collective, so the
+        # default path fuses the gradient tree into a few flat buckets
+        # (one compressed collective each); GEOMX_BUCKET_BYTES=0 or
+        # bucket_bytes=0 restores the per-leaf path.  The ICI-tier worker
+        # compressor stays per-leaf — intra-DC latency doesn't warrant
+        # the re-layout.
+        self.dc_compressor = maybe_bucketed(dc_compressor or NoCompressor(),
+                                            bucket_bytes)
         self.worker_compressor = worker_compressor or NoCompressor()
 
     def init_state(self, params: Any) -> Any:

@@ -39,10 +39,15 @@ class MixedSync(SyncAlgorithm):
     name = "mixed"
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
-                 pull_interval: int = 1, dcasgd_lambda: float = 0.0):
+                 pull_interval: int = 1, dcasgd_lambda: float = 0.0,
+                 bucket_bytes: Optional[int] = None):
         if pull_interval < 1:
             raise ValueError("pull_interval must be >= 1")
-        self.dc_compressor = dc_compressor or NoCompressor()
+        from geomx_tpu.compression.bucketing import maybe_bucketed
+        # same dc-tier default as FSA: fused flat-bucket collectives
+        # (GEOMX_BUCKET_BYTES=0 opts out)
+        self.dc_compressor = maybe_bucketed(dc_compressor or NoCompressor(),
+                                            bucket_bytes)
         self.pull_interval = int(pull_interval)
         self.dcasgd_lambda = float(dcasgd_lambda)
 

@@ -105,7 +105,17 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                 f"algorithms ({sync.name}) do not compose with it")
         mgps = MultiGPSPlan(config.bigarray_bound, topology.workers_per_party)
         from geomx_tpu.compression.base import NoCompressor
+        from geomx_tpu.compression.bucketing import BucketedCompressor
         from geomx_tpu.sync.dgt import DGTCompressor
+        if isinstance(sync.dc_compressor, BucketedCompressor):
+            # MultiGPS keeps PER-LEAF dc semantics: big leaves cross the
+            # WAN as 1/W worker-axis shards while small leaves stay
+            # replicated, and the Trainer initializes shard-shaped
+            # per-leaf compressor state (mixed_example).  Fusing shard
+            # and replicated leaves into one bucket would pool their
+            # top-k budgets across tensors that live on different
+            # layouts, so unwrap back to the inner compressor here.
+            sync.dc_compressor = sync.dc_compressor.inner
         if isinstance(sync.worker_compressor, DGTCompressor):
             # DGT's state is one flat schedule for the WHOLE gradient
             # (sync/dgt.py tree-level path); the MultiGPS update needs

@@ -45,6 +45,41 @@ def enable_compile_cache(path: str | None = None,
 
     import jax
 
+    # CPU-backend veto (applies even to an explicit path — it is a
+    # correctness guard, not a preference): jaxlib 0.4.x CPU executables
+    # deserialized from the persistent cache corrupt the heap when the
+    # program donates input buffers — glibc "corrupted double-linked
+    # list" / SIGSEGV after a few invocations, reproduced with
+    # jit(shard_map(train_step), donate_argnums=(0,)) warm-started from
+    # the cache on jaxlib 0.4.37; the cold (writing) process is fine.
+    # Donated train steps are exactly the cache's payload, so on CPU the
+    # cache trades minutes of compile time for a crashing second run.
+    # GEOMX_COMPILE_CACHE_CPU=1 overrides (e.g. a jaxlib with the
+    # deserialization bug fixed).
+    #
+    # Platform detection must not force backend initialization: callers
+    # like a multi-host launcher may enable the cache before
+    # jax.distributed.initialize(), and default_backend() would lock the
+    # backend config.  Consult the jax_platforms config first (the test
+    # conftest and CPU-debug paths set it explicitly); only fall back to
+    # default_backend() when a backend already exists.
+    on_cpu = False
+    try:
+        plats = jax.config.jax_platforms
+    except Exception:
+        plats = None
+    if plats:
+        on_cpu = plats.split(",")[0].strip().lower() == "cpu"
+    else:
+        try:
+            from jax._src import xla_bridge as _xb
+            if getattr(_xb, "_backends", None):
+                on_cpu = jax.default_backend() == "cpu"
+        except Exception:
+            pass
+    if on_cpu and os.environ.get("GEOMX_COMPILE_CACHE_CPU") != "1":
+        return None
+
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_seconds)

@@ -101,10 +101,15 @@ class Profiler:
             })
 
     @contextlib.contextmanager
-    def scope(self, name: str, category: str = "host"):
+    def scope(self, name: str, category: str = "host",
+              args: Optional[Dict] = None):
         """Record a named duration; also annotates the XLA trace so the
         scope shows up inside TensorBoard device profiles (the analogue of
-        engine ops carrying profiler names, kvstore_dist.h:654)."""
+        engine ops carrying profiler names, kvstore_dist.h:654).
+
+        ``args`` attaches structured metadata to the Chrome-trace event —
+        the bucketed communication engine uses it to report per-bucket
+        payload sizes ({"bucket", "elems", "padded", "payload_bytes"})."""
         if not self.running:
             yield
             return
@@ -125,7 +130,7 @@ class Profiler:
                     ann.__exit__(None, None, None)
                 except Exception:
                     pass
-            self.add_event(name, begin, self._now_us(), category)
+            self.add_event(name, begin, self._now_us(), category, args)
 
     # ---- device (XLA) traces ----------------------------------------------
     def start_device_trace(self, logdir: str) -> None:
@@ -198,6 +203,7 @@ def get_profiler() -> Profiler:
 
 
 @contextlib.contextmanager
-def profile_scope(name: str, category: str = "host"):
-    with get_profiler().scope(name, category):
+def profile_scope(name: str, category: str = "host",
+                  args: Optional[Dict] = None):
+    with get_profiler().scope(name, category, args=args):
         yield

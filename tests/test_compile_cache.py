@@ -1,0 +1,16 @@
+"""The persistent compile cache must refuse the CPU backend: jaxlib
+0.4.x CPU executables deserialized from the cache corrupt the heap when
+the program donates input buffers (warm-run SIGSEGV — every jitted train
+step donates).  See utils/compile_cache.py."""
+
+import jax
+
+
+def test_enable_compile_cache_vetoes_cpu_backend(tmp_path, monkeypatch):
+    from geomx_tpu.utils import enable_compile_cache
+
+    assert jax.default_backend() == "cpu"  # the suite forces CPU
+    monkeypatch.delenv("GEOMX_COMPILE_CACHE_CPU", raising=False)
+    # even an explicit path is vetoed — correctness guard, not preference
+    assert enable_compile_cache(str(tmp_path / "cc")) is None
+    assert enable_compile_cache() is None

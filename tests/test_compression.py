@@ -32,6 +32,52 @@ def test_get_compressor_specs():
         get_compressor("unknown")
 
 
+def test_get_compressor_keyword_args():
+    """"bsc,0.01" cannot express select=/min_sparse_size=; the key=value
+    extension can, mixing with positionals."""
+    c = get_compressor("bsc,0.01,select=sampled,min_sparse_size=2048")
+    assert isinstance(c, BiSparseCompressor)
+    assert c.ratio == pytest.approx(0.01)
+    assert c.select == "sampled" and c.min_sparse_size == 2048
+    # pure-keyword form
+    c2 = get_compressor("bsc,ratio=0.05,select=exact")
+    assert c2.ratio == pytest.approx(0.05) and c2.select == "exact"
+    import jax.numpy as jnp
+    assert get_compressor("fp16,bf16=1").wire_dtype == jnp.bfloat16
+    m = get_compressor("mpq,ratio=0.02,size_lower_bound=5000")
+    assert m.size_lower_bound == 5000
+    assert m.large.ratio == pytest.approx(0.02)
+    t = get_compressor("2bit,threshold=0.25")
+    assert t.threshold == pytest.approx(0.25)
+
+
+def test_get_compressor_rejects_bad_keyword_specs():
+    with pytest.raises(ValueError, match="Unknown argument 'bogus'"):
+        get_compressor("bsc,0.01,bogus=1")
+    with pytest.raises(ValueError, match="valid keys"):
+        get_compressor("fp16,ratio=0.5")
+    with pytest.raises(ValueError, match="after keyword"):
+        get_compressor("bsc,select=exact,0.01")
+    with pytest.raises(ValueError, match="Duplicate"):
+        get_compressor("bsc,0.01,ratio=0.02")
+    with pytest.raises(ValueError, match="Too many positional"):
+        get_compressor("2bit,0.5,7")
+    with pytest.raises(ValueError):
+        get_compressor("fp16,bf16=maybe")
+
+
+def test_dense_wire_bytes_use_leaf_dtype():
+    """Regression: the dense default hardcoded 4 bytes/element, which
+    overcounted bf16/fp16 leaves 2x."""
+    c = NoCompressor()
+    assert c.wire_bytes_leaf(jnp.zeros((100,), jnp.float32)) == 400
+    assert c.wire_bytes_leaf(jnp.zeros((100,), jnp.bfloat16)) == 200
+    assert c.wire_bytes_leaf(jnp.zeros((100,), jnp.float16)) == 200
+    tree = {"a": jnp.zeros((10,), jnp.float32),
+            "b": jnp.zeros((10,), jnp.bfloat16)}
+    assert c.wire_bytes(tree) == 40 + 20
+
+
 # ---------- 2-bit ----------
 
 def test_pack_unpack_roundtrip(rng):

@@ -55,6 +55,86 @@ def test_pallas_compressor_matches_jnp_path(topo2x4, mesh2x4):
     np.testing.assert_allclose(out_p, out_j, atol=1e-6)
 
 
+# ---------- sampled_topk padding-sentinel semantics ----------
+
+def test_sampled_select_all_zero_input_emits_k_slots():
+    from geomx_tpu.compression import BiSparseCompressor
+    from geomx_tpu.ops.sampled_topk import sampled_threshold_select
+
+    n, k = 4096, 40
+    v = jnp.zeros((n,), jnp.float32)
+    vals, idx, keep = sampled_threshold_select(v, jnp.abs(v), k)
+    # exactly k wire slots, regardless of input content
+    assert vals.shape == (k,) and idx.shape == (k,)
+    # zero boundary ties everything; the fixed buffer fills with k
+    # (zero-valued) coordinates, never more
+    assert int((np.asarray(idx) >= 0).sum()) == k
+    assert int(np.asarray(keep).sum()) == k
+    out = BiSparseCompressor(ratio=0.01, min_sparse_size=1).decompress(
+        vals, idx, n)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(n, np.float32))
+
+
+def test_sampled_select_ties_fill_exactly_k():
+    from geomx_tpu.ops.sampled_topk import sampled_threshold_select
+
+    n, k = 2048, 32
+    v = jnp.full((n,), -0.75, jnp.float32)  # every element tied at |thr|
+    vals, idx, keep = sampled_threshold_select(v, jnp.abs(v), k)
+    assert vals.shape == (k,) and idx.shape == (k,)
+    valid = np.asarray(idx) >= 0
+    assert valid.sum() == k  # ties fill the buffer, never overflow it
+    np.testing.assert_allclose(np.asarray(vals)[valid], -0.75)
+    # first-k-in-index-order wins on ties (the reference's scan order)
+    np.testing.assert_array_equal(np.sort(np.asarray(idx)[valid]),
+                                  np.arange(k))
+
+
+def test_sampled_select_n_smaller_than_k_pads_with_sentinels():
+    from geomx_tpu.compression import BiSparseCompressor
+    from geomx_tpu.ops.sampled_topk import sampled_threshold_select
+
+    n, k = 10, 32
+    rng = np.random.RandomState(3)
+    g = rng.randn(n).astype(np.float32)
+    v = jnp.asarray(g)
+    vals, idx, keep = sampled_threshold_select(v, jnp.abs(v), k)
+    # still exactly k wire slots: n real coordinates + (k - n) sentinels
+    assert vals.shape == (k,) and idx.shape == (k,)
+    idx_np = np.asarray(idx)
+    assert (idx_np >= 0).sum() == n
+    assert (idx_np < 0).sum() == k - n
+    np.testing.assert_array_equal(np.asarray(vals)[idx_np < 0], 0.0)
+    # decompress drops the negative-index sentinels and reconstructs
+    # every real coordinate
+    out = BiSparseCompressor(ratio=0.5, min_sparse_size=1).decompress(
+        vals, idx, n)
+    np.testing.assert_allclose(np.asarray(out), g, rtol=1e-6)
+
+
+def test_bsc_sampled_compress_drops_sentinels_through_decompress():
+    """End-to-end through BiSparseCompressor: a sentinel-padded sampled
+    payload round-trips the compress -> decompress pipe with the padding
+    contributing nothing."""
+    from geomx_tpu.compression import BiSparseCompressor
+
+    n = 8192
+    c = BiSparseCompressor(ratio=0.01, min_sparse_size=1, select="sampled")
+    g = np.zeros(n, np.float32)
+    g[7] = 3.0
+    g[4096] = -2.0  # only 2 nonzeros; k = 82 slots mostly padding-bound
+    vals, idx, u2, v2 = c.compress(jnp.asarray(g), jnp.zeros((n,)),
+                                   jnp.zeros((n,)))
+    k = c.k_for(n)
+    assert vals.shape == (k,) and idx.shape == (k,)
+    out = np.asarray(c.decompress(vals, idx, n))
+    # the two real coordinates arrive; ties at zero may fill other slots
+    # with zero-valued (harmless) entries, sentinels add nothing
+    assert out[7] == pytest.approx(3.0)
+    assert out[4096] == pytest.approx(-2.0)
+    np.testing.assert_allclose(out + np.asarray(v2), g, atol=1e-6)
+
+
 def test_twobit_kernels_lower_to_tpu_mosaic_without_a_device():
     """Same guard as the flash kernel's: cross-platform export runs the
     Pallas->Mosaic lowering pass for TPU on any host, so a future edit
