@@ -37,6 +37,13 @@ Micro-modes:
       for each compression spec on the seed model: collective launches
       per step (counted in the traced jaxpr), wire bytes, and per-bucket
       payloads.  CPU, seconds, no TPU needed.
+  bench.py --compare-pipeline [--model=resnet20] [--dcn-ms=100]
+           [--compression=none] [--batch=64] [--iters=8]
+      One JSON line comparing synchronous vs pipelined
+      (GEOMX_PIPELINE_DEPTH=1) dc-tier sync: measured compute step time,
+      the DCE-verified count of dc collectives the weight update waits
+      on (0 under pipelining), and the modeled step time / overlap ratio
+      under an injected DCN delay.  CPU, no TPU needed.
 
 Env knobs:
   GEOMX_BENCH_PLATFORM=cpu   debug on the host CPU (tiny shapes)
@@ -949,6 +956,202 @@ def compare_bucketing_main(argv):
 
 
 # --------------------------------------------------------------------------
+# --compare-pipeline: synchronous vs double-buffered dc-tier sync
+# --------------------------------------------------------------------------
+
+
+def _collect_dc_collectives(jaxpr) -> int:
+    """Count collectives over the "dc" mesh axis in a (closed) jaxpr,
+    recursing into nested jaxprs."""
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    count = 0
+    for eqn in core.eqns:
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            axes = eqn.params.get(
+                "axes", eqn.params.get("axis_name",
+                                       eqn.params.get("axis_names", ())))
+            if isinstance(axes, str):
+                axes = (axes,)
+            try:
+                if "dc" in tuple(axes):
+                    count += 1
+            except TypeError:
+                pass
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    count += _collect_dc_collectives(sub)
+    return count
+
+
+def _dc_weight_path_analysis(train_step, state, xb, yb):
+    """The structural claim --compare-pipeline verifies: how many dc-axis
+    collectives the *weight update* actually waits on.  Dead-code-
+    eliminate the traced step keeping only the params/opt_state/
+    model_state outputs (jax's dce_jaxpr recurses through pjit/
+    shard_map/cond), then count dc collectives in what survives.
+    Synchronous FSA keeps its gradient collective and the BatchNorm-stat
+    pmean (the optimizer and the next forward consume them); the
+    pipelined step keeps NONE — its collectives feed only sync_state,
+    i.e. the next step."""
+    import jax
+
+    closed = jax.make_jaxpr(train_step)(state, xb, yb)
+    out_shapes = jax.eval_shape(train_step, state, xb, yb)
+    flat, treedef = jax.tree.flatten(out_shapes)
+    idx_tree = jax.tree.unflatten(treedef, list(range(len(flat))))
+    new_state, _metrics = idx_tree
+    keep = set(jax.tree.leaves((new_state.params, new_state.opt_state,
+                                new_state.model_state)))
+    used = [i in keep for i in range(len(flat))]
+    total = _collect_dc_collectives(closed.jaxpr)
+    try:
+        from jax._src.interpreters import partial_eval as pe
+        dced, _used_ins = pe.dce_jaxpr(closed.jaxpr, used)
+        on_path = _collect_dc_collectives(dced)
+    except Exception as e:  # private API moved: report, don't guess
+        return {"dc_collectives_total": total,
+                "dc_collectives_on_weight_path": None,
+                "analysis_error": repr(e)}
+    return {"dc_collectives_total": total,
+            "dc_collectives_on_weight_path": on_path}
+
+
+def _compare_pipeline(model_name: str = "resnet20", dcn_ms: float = 100.0,
+                      compression: str = "none", batch: int = 64,
+                      iters: int = 8, dcasgd_lambda: float = 0.04):
+    """Synchronous vs pipelined dc-tier sync on a 2-party mesh: measured
+    compute step time, the DCE-verified dependency structure, and the
+    modeled step time under an injected DCN delay.
+
+    The delay is *modeled*, not slept: a host backend executes programs
+    serially, so a wall-clock sleep would penalize both modes equally.
+    What IS measured from the real programs: (a) each mode's compute
+    step time, and (b) — the load-bearing fact — whether the weight
+    update waits on this step's dc collective (backward slice of the
+    traced jaxpr).  The model then charges the delay only where the
+    dependency structure says a step blocks on the WAN:
+
+        sync      = t_step + dcn_delay          (collective on the path)
+        pipelined = max(t_step, dcn_delay)      (full-step overlap)
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    if dcn_ms <= 0:
+        raise ValueError(f"--dcn-ms must be > 0 (got {dcn_ms:g}): the "
+                         "mode exists to model a WAN delay; with no "
+                         "delay there is nothing to overlap")
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "compare-pipeline needs >= 2 devices for the dc axis (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    local_b = max(1, batch // 2)
+    rng = np.random.RandomState(0)
+    x = (rng.rand(2, 1, local_b, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(2, 1, local_b)).astype(np.int32)
+
+    def measure(pipeline_depth):
+        cfg = GeoConfig(num_parties=2, workers_per_party=1,
+                        compression=compression,
+                        pipeline_depth=pipeline_depth,
+                        pipeline_dcasgd=(dcasgd_lambda
+                                         if pipeline_depth else 0.0))
+        sync = get_sync_algorithm(cfg)
+        trainer = Trainer(get_model(model_name, num_classes=10), topo,
+                          optax.sgd(0.1, momentum=0.9), sync=sync,
+                          config=cfg)
+        sharding = topo.batch_sharding(trainer.mesh)
+        xb = jax.device_put(x, sharding)
+        yb = jax.device_put(y, sharding)
+        state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+        structure = _dc_weight_path_analysis(trainer.train_step, state,
+                                             xb, yb)
+        comp = sync.dc_compressor if pipeline_depth == 0 \
+            else sync.inner.dc_compressor
+        params = jax.tree.map(lambda a: a[0, 0], state.params)
+        wire = int(comp.wire_bytes(params))
+        state, metrics = trainer.train_step(state, xb, yb)  # compile+warm
+        state, metrics = trainer.train_step(state, xb, yb)
+        jax.block_until_ready(metrics["loss"])
+        dt = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, metrics = trainer.train_step(state, xb, yb)
+            jax.block_until_ready(metrics["loss"])
+            d = time.perf_counter() - t0
+            dt = d if dt is None else min(dt, d)
+        return {"step_time_ms": round(dt / iters * 1e3, 3),
+                "wire_bytes_per_step": wire, **structure}
+
+    sync_rec = measure(0)
+    pipe_rec = measure(1)
+
+    out = {"mode": "compare_pipeline", "model": model_name,
+           "compression": compression, "batch": batch, "iters": iters,
+           "dcn_delay_ms": dcn_ms,
+           "pipeline_dcasgd_lambda": dcasgd_lambda,
+           "sync": sync_rec, "pipelined": pipe_rec,
+           "note": ("dcn delay is modeled on the DCE-verified dependency "
+                    "structure (a host backend executes serially, so a "
+                    "slept delay would block both modes); step_time_ms "
+                    "and the collective counts are measured")}
+    s_on = sync_rec.get("dc_collectives_on_weight_path")
+    p_on = pipe_rec.get("dc_collectives_on_weight_path")
+    if s_on is not None and p_on is not None:
+        t_s, t_p = sync_rec["step_time_ms"], pipe_rec["step_time_ms"]
+
+        def modeled(t, on_path, d):
+            return t + d if on_path else max(t, d)
+
+        # sweep: at delays far below the step's compute the pipeline's
+        # buffer-copy overhead can outweigh the hidden latency (honest
+        # negative); at geo-WAN delays the hidden round trip dominates
+        sweep = {}
+        for d in sorted({10.0, 25.0, 50.0, 100.0, 250.0, dcn_ms}):
+            ms, mp = modeled(t_s, s_on, d), modeled(t_p, p_on, d)
+            sweep[str(int(d) if float(d).is_integer() else d)] = {
+                "sync_ms": round(ms, 3), "pipelined_ms": round(mp, 3),
+                "overlap_ratio": round((ms - mp) / d, 4),
+                "speedup": round(ms / mp, 4)}
+        out["delay_sweep_ms"] = sweep
+        model_s = modeled(t_s, s_on, dcn_ms)
+        model_p = modeled(t_p, p_on, dcn_ms)
+        out["sync"]["modeled_step_ms_under_delay"] = round(model_s, 3)
+        out["pipelined"]["modeled_step_ms_under_delay"] = round(model_p, 3)
+        out["overlap_ratio"] = round((model_s - model_p) / dcn_ms, 4)
+        out["speedup_under_delay"] = round(model_s / model_p, 4)
+        out["overlaps_compute"] = (p_on == 0 and model_p < model_s)
+    return out
+
+
+def compare_pipeline_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--dcn-ms="):
+            kwargs["dcn_ms"] = float(a.split("=", 1)[1])
+        elif a.startswith("--compression="):
+            kwargs["compression"] = a.split("=", 1)[1]
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--iters="):
+            kwargs["iters"] = int(a.split("=", 1)[1])
+    _emit(_compare_pipeline(**kwargs))
+
+
+# --------------------------------------------------------------------------
 # parent: watchdog + single-line aggregation
 # --------------------------------------------------------------------------
 
@@ -1239,7 +1442,17 @@ def parent_main():
 
 
 def main():
-    if "--compare-bucketing" in sys.argv:
+    if "--compare-pipeline" in sys.argv:
+        # accounting/structure micro-mode like --compare-bucketing:
+        # in-process on the CPU backend with a 2-device virtual mesh
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2").strip()
+        compare_pipeline_main(sys.argv[1:])
+    elif "--compare-bucketing" in sys.argv:
         # accounting micro-mode, not a perf mode: runs in-process on the
         # CPU backend with a 2-device virtual mesh (env must be set
         # before the first jax import — bench.py imports jax lazily)

@@ -22,7 +22,6 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-import numpy as np
 
 
 def main():
@@ -45,7 +44,6 @@ def main():
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import optax
 
     from geomx_tpu import GeoConfig, HiPSTopology
     from geomx_tpu.data import load_dataset

@@ -4,7 +4,6 @@
 The -bcr ratio defaults to 0.01 as in the reference; the cross-party push
 and pull both move only ~ratio of each large tensor (2*k floats/party)."""
 
-import sys
 
 from cnn_common import run
 

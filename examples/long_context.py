@@ -51,7 +51,6 @@ def main(sp_mode=None):
     # chips (querying the backend first would commit it prematurely)
     if os.environ.get("GEOMX_PLATFORM", "cpu") != "tpu":
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import optax
 
     from geomx_tpu.models import SeqClassifier

@@ -195,19 +195,19 @@ class BucketedCompressor(Compressor):
         return self.inner.init_leaf_state(_bucket_leaf(bk.bucket_sizes[0]))
 
     # -- the fused all-reduce ------------------------------------------------
-    def allreduce(self, grads: Any, state: Any, axis_name: str,
-                  axis_size: int) -> Tuple[Any, Any]:
-        leaves, treedef = jax.tree.flatten(grads)
-        if not leaves:
-            return grads, state
-        bk = self._bucketer(leaves)
+    def allreduce_buckets(self, buckets: Sequence[jax.Array], state: Any,
+                          axis_name: str, axis_size: int,
+                          bk: GradientBucketer) -> Tuple[List[jax.Array], Any]:
+        """One compressed collective per flat bucket; the layer the
+        pipelined engine (sync/pipeline.py) calls directly so its
+        in-flight double-buffer can live on the bucket layout without a
+        re-flatten round trip."""
         if len(state) != bk.num_buckets:
             raise ValueError(
                 f"bucketed state has {len(state)} buckets but the gradient "
                 f"layout needs {bk.num_buckets} — state was initialized "
                 "from a different tree (init_state and allreduce must see "
                 "the same pytree structure)")
-        buckets = bk.flatten(leaves)
         out_buckets, new_states = [], []
         for i, (b, s) in enumerate(zip(buckets, state)):
             # host-side trace span + XLA TraceAnnotation: the bucket's ops
@@ -222,6 +222,16 @@ class BucketedCompressor(Compressor):
                                                    axis_size)
             out_buckets.append(ob)
             new_states.append(ns)
+        return out_buckets, new_states
+
+    def allreduce(self, grads: Any, state: Any, axis_name: str,
+                  axis_size: int) -> Tuple[Any, Any]:
+        leaves, treedef = jax.tree.flatten(grads)
+        if not leaves:
+            return grads, state
+        bk = self._bucketer(leaves)
+        out_buckets, new_states = self.allreduce_buckets(
+            bk.flatten(leaves), state, axis_name, axis_size, bk)
         return treedef.unflatten(bk.unflatten(out_buckets)), new_states
 
     def allreduce_leaf(self, g: jax.Array, state: Any, axis_name: str,

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
 
 
 def _env(names, default, cast):
@@ -76,6 +75,16 @@ class GeoConfig:
     # per-leaf path
     bucket_bytes: int = 4 * 1024 * 1024
 
+    # ---- pipelined WAN sync (sync/pipeline.py): double-buffer the
+    # dc-tier collective so the DCN round trip overlaps the next step's
+    # compute (staleness 1).  0 = off (synchronous dc tier); 1 = double
+    # buffering.  FSA/MixedSync only — HFA and MultiGPS reject loudly.
+    pipeline_depth: int = 0
+    # DCASGD-style staleness compensation for the pipelined aggregate:
+    # g + lambda*g^2*(w - w_prev); 0 disables (the lambda scale matches
+    # GEOMX_DCASGD_LAMBDA — 0.04 is the reference default strength)
+    pipeline_dcasgd: float = 0.0
+
     # ---- MultiGPS parameter sharding
     # tensors >= this many elements are sharded across the global-server axis
     # (reference MXNET_KVSTORE_BIGARRAY_BOUND, src/kvstore/kvstore_dist.h:69)
@@ -126,6 +135,9 @@ class GeoConfig:
                 200_000, int),
             bucket_bytes=_env(["GEOMX_BUCKET_BYTES"], 4 * 1024 * 1024,
                               lambda s: int(float(s))),
+            pipeline_depth=_env(["GEOMX_PIPELINE_DEPTH"], 0,
+                                lambda s: int(float(s))),
+            pipeline_dcasgd=_env(["GEOMX_PIPELINE_DCASGD"], 0.0, float),
             bigarray_bound=_env(
                 ["GEOMX_BIGARRAY_BOUND", "MXNET_KVSTORE_BIGARRAY_BOUND"],
                 1_000_000, int),

@@ -19,7 +19,7 @@ import gzip
 import os
 import pickle
 import struct
-from typing import Dict, Tuple
+from typing import Tuple
 
 import numpy as np
 

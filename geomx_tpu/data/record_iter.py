@@ -13,8 +13,7 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu.data.recordio import (RecordIOReader, recordio_reader,
-                                     shard_bounds,
+from geomx_tpu.data.recordio import (recordio_reader, shard_bounds,
                                      unpack_labelled)
 
 

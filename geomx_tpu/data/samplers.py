@@ -11,7 +11,7 @@ Parity with the reference's samplers (examples/utils.py:10-36):
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 

@@ -10,7 +10,6 @@ prefers channels-last).
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class GeoCNN(nn.Module):

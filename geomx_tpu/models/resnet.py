@@ -10,7 +10,7 @@ bf16 throughput while the optimizer and BatchNorm stay fp32.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

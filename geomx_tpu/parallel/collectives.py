@@ -11,11 +11,9 @@ compute — no engine threads, no explicit messages.
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 from geomx_tpu.topology import DC_AXIS, WORKER_AXIS

@@ -18,7 +18,6 @@ third.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -26,7 +26,7 @@ hop exactly as in the reference.
 from __future__ import annotations
 
 import pickle
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp

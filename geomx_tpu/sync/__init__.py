@@ -16,9 +16,10 @@ from geomx_tpu.sync.fsa import FSA
 from geomx_tpu.sync.hfa import HFA
 from geomx_tpu.sync.mixed import MixedSync
 from geomx_tpu.sync.dgt import DGTCompressor
+from geomx_tpu.sync.pipeline import PipelinedSync
 
 __all__ = ["SyncAlgorithm", "FSA", "HFA", "MixedSync", "DGTCompressor",
-           "get_sync_algorithm"]
+           "PipelinedSync", "get_sync_algorithm"]
 
 
 def get_sync_algorithm(cfg, compressor=None):
@@ -32,15 +33,36 @@ def get_sync_algorithm(cfg, compressor=None):
     mode = cfg.sync_mode.lower()
     bucket_bytes = getattr(cfg, "bucket_bytes", None)
     if mode in ("fsa", "dist_sync", "sync"):
-        return FSA(dc_compressor=comp, bucket_bytes=bucket_bytes)
-    if mode in ("mixed", "dist_async", "async"):
+        algo = FSA(dc_compressor=comp, bucket_bytes=bucket_bytes)
+    elif mode in ("mixed", "dist_async", "async"):
         # DCASGD compensation is opt-in (reference: --dcasgd flag selects it;
         # plain --mixed-sync runs the uncompensated optimizer)
         lam = cfg.dcasgd_lambda if getattr(cfg, "dcasgd", False) else 0.0
-        return MixedSync(dc_compressor=comp,
+        algo = MixedSync(dc_compressor=comp,
                          pull_interval=cfg.mixed_pull_interval,
                          dcasgd_lambda=lam,
                          bucket_bytes=bucket_bytes)
-    if mode == "hfa":
-        return HFA(k1=cfg.hfa_k1, k2=cfg.hfa_k2, dc_compressor=comp)
-    raise ValueError(f"Unknown sync mode: {cfg.sync_mode!r}")
+    elif mode == "hfa":
+        algo = HFA(k1=cfg.hfa_k1, k2=cfg.hfa_k2, dc_compressor=comp,
+                   bucket_bytes=bucket_bytes)
+    else:
+        raise ValueError(f"Unknown sync mode: {cfg.sync_mode!r}")
+    depth = getattr(cfg, "pipeline_depth", 0)
+    if depth and cfg.num_parties <= 1:
+        # same single-axis elision policy as the x/1 divide guards and
+        # HFA's one-party milestone skip: with one party there is no
+        # dc-tier round trip to hide, and staleness-1 would only degrade
+        # the trajectory (a cluster launch script's exported
+        # GEOMX_PIPELINE_DEPTH must not taint a 1-party debug run)
+        import warnings
+        warnings.warn(
+            "GEOMX_PIPELINE_DEPTH ignored: num_parties == 1 has no "
+            "dc-tier collective to pipeline", stacklevel=2)
+    elif depth:
+        # opt-in pipelined WAN sync: double-buffer the dc-tier collective
+        # so the DCN round trip overlaps the next step's compute
+        # (sync/pipeline.py); rejects HFA loudly inside the constructor
+        algo = PipelinedSync(algo, depth=depth,
+                             dcasgd_lambda=getattr(cfg, "pipeline_dcasgd",
+                                                   0.0))
+    return algo

@@ -34,8 +34,13 @@ class SyncAlgorithm(abc.ABC):
         self.workers_per_party = topology.workers_per_party
         return self
 
-    def init_state(self, params: Any) -> Any:
-        """Algorithm state from example (unsharded, single-replica) params."""
+    def init_state(self, params: Any, model_state: Any = None) -> Any:
+        """Algorithm state from example (unsharded, single-replica) params.
+
+        ``model_state`` (non-trainable collections, e.g. BatchNorm stats)
+        is offered so algorithms that double-buffer the model-state sync
+        (PipelinedSync) can size/seed their buffer; most algorithms
+        ignore it."""
         return {}
 
     def forward_params(self, params: Any, state: Any) -> Any:
@@ -49,6 +54,12 @@ class SyncAlgorithm(abc.ABC):
                     step: jax.Array) -> Tuple[Any, Any]:
         return params, state
 
-    def sync_model_state(self, model_state: Any, step: jax.Array) -> Any:
-        """Hook for non-trainable model state (e.g. BatchNorm statistics)."""
-        return model_state
+    def sync_model_state(self, model_state: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
+        """Hook for non-trainable model state (e.g. BatchNorm statistics).
+
+        Threads the sync-algorithm state like the other hooks so stateful
+        model-state schedules (PipelinedSync's double-buffered dc-tier
+        pmean) are expressible; stateless algorithms return ``state``
+        unchanged."""
+        return model_state, state

@@ -51,7 +51,7 @@ class FSA(SyncAlgorithm):
                                             bucket_bytes)
         self.worker_compressor = worker_compressor or NoCompressor()
 
-    def init_state(self, params: Any) -> Any:
+    def init_state(self, params: Any, model_state: Any = None) -> Any:
         return {
             "dc_comp": self.dc_compressor.init_state(params),
             "worker_comp": self.worker_compressor.init_state(params),
@@ -64,16 +64,19 @@ class FSA(SyncAlgorithm):
         # intra-party tier (ICI): mean over workers
         g, wstate = self.worker_compressor.allreduce(
             grads, state["worker_comp"], WORKER_AXIS, nw)
-        g = jax.tree.map(lambda x: x / nw, g)
+        if nw > 1:  # single-worker parties skip the dead x/1 divide
+            g = jax.tree.map(lambda x: x / nw, g)
         # cross-party tier (DCN): compressed mean over parties
         g, dstate = self.dc_compressor.allreduce(g, state["dc_comp"], DC_AXIS, np_)
-        g = jax.tree.map(lambda x: x / np_, g)
+        if np_ > 1:
+            g = jax.tree.map(lambda x: x / np_, g)
         return g, {"dc_comp": dstate, "worker_comp": wstate}
 
-    def sync_model_state(self, model_state: Any, step: jax.Array) -> Any:
+    def sync_model_state(self, model_state: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
         # keep non-trainable stats (BatchNorm) consistent across replicas
         if self.workers_per_party > 1:
             model_state = lax.pmean(model_state, WORKER_AXIS)
         if self.num_parties > 1:
             model_state = lax.pmean(model_state, DC_AXIS)
-        return model_state
+        return model_state, state

@@ -40,14 +40,22 @@ class HFA(SyncAlgorithm):
     name = "hfa"
 
     def __init__(self, k1: int = 20, k2: int = 10,
-                 dc_compressor: Optional[Compressor] = None):
+                 dc_compressor: Optional[Compressor] = None,
+                 bucket_bytes: Optional[int] = None):
         if k1 < 1 or k2 < 1:
             raise ValueError("HFA periods must be >= 1")
+        from geomx_tpu.compression.bucketing import maybe_bucketed
         self.k1 = int(k1)
         self.k2 = int(k2)
-        self.dc_compressor = dc_compressor or NoCompressor()
+        # the K1*K2 global delta crosses the same WAN hop as FSA's
+        # gradients, so it gets the same fused flat-bucket default: one
+        # compressed collective per bucket instead of per leaf
+        # (GEOMX_BUCKET_BYTES=0 opts out).  Exact for the dense default
+        # (the bucket layout is a permutation and the padding is zeros).
+        self.dc_compressor = maybe_bucketed(dc_compressor or NoCompressor(),
+                                            bucket_bytes)
 
-    def init_state(self, params: Any) -> Any:
+    def init_state(self, params: Any, model_state: Any = None) -> Any:
         if self.num_parties <= 1:
             # one party: the global tier never fires (the Python gate in
             # sync_params), so a milestone copy + compressor state would
@@ -100,9 +108,10 @@ class HFA(SyncAlgorithm):
                                      (params, state))
         return params, state
 
-    def sync_model_state(self, model_state: Any, step: jax.Array) -> Any:
+    def sync_model_state(self, model_state: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
         if not jax.tree.leaves(model_state):
-            return model_state
+            return model_state, state
         iters = step + 1
         if self.workers_per_party > 1:
             model_state = lax.cond(
@@ -112,4 +121,4 @@ class HFA(SyncAlgorithm):
             model_state = lax.cond(
                 (iters % (self.k1 * self.k2)) == 0,
                 lambda s: lax.pmean(s, DC_AXIS), lambda s: s, model_state)
-        return model_state
+        return model_state, state

@@ -51,7 +51,7 @@ class MixedSync(SyncAlgorithm):
         self.pull_interval = int(pull_interval)
         self.dcasgd_lambda = float(dcasgd_lambda)
 
-    def init_state(self, params: Any) -> Any:
+    def init_state(self, params: Any, model_state: Any = None) -> Any:
         return {
             "stale": jax.tree.map(jnp.asarray, params),
             "dc_comp": self.dc_compressor.init_state(params),
@@ -76,7 +76,8 @@ class MixedSync(SyncAlgorithm):
         np_ = self.num_parties
         grads, dstate = self.dc_compressor.allreduce(
             grads, state["dc_comp"], DC_AXIS, np_)
-        grads = jax.tree.map(lambda g: g / np_, grads)
+        if np_ > 1:  # single-party configs skip the dead g/1 divide
+            grads = jax.tree.map(lambda g: g / np_, grads)
         state = dict(state, dc_comp=dstate)
         return grads, state
 
@@ -87,11 +88,12 @@ class MixedSync(SyncAlgorithm):
         stale = lax.cond(do_pull, lambda _: params, lambda s: s, state["stale"])
         return params, dict(state, stale=stale)
 
-    def sync_model_state(self, model_state: Any, step: jax.Array) -> Any:
+    def sync_model_state(self, model_state: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
         if not jax.tree.leaves(model_state):
-            return model_state
+            return model_state, state
         if self.workers_per_party > 1:
             model_state = lax.pmean(model_state, WORKER_AXIS)
         if self.num_parties > 1:
             model_state = lax.pmean(model_state, DC_AXIS)
-        return model_state
+        return model_state, state

@@ -11,14 +11,13 @@ engine threads for comes from the scheduler here).
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from geomx_tpu.parallel.collectives import shard_map_compat
 from geomx_tpu.sync.base import SyncAlgorithm
@@ -96,6 +95,18 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     if config is not None and getattr(config, "multi_gps", False):
         from geomx_tpu.parallel.multigps import MultiGPSPlan
         from geomx_tpu.sync.fsa import FSA
+        from geomx_tpu.sync.pipeline import PipelinedSync
+        if isinstance(sync, PipelinedSync):
+            # fail loudly (same contract as the FSA check below): the
+            # ZeRO-1 update consumes the dc-tier shard in-step by
+            # construction (reduce_scatter -> shard-local optimizer ->
+            # all_gather), so there is no next-step slot to double-buffer
+            # the collective into
+            raise ValueError(
+                "GEOMX_MULTI_GPS does not compose with "
+                "GEOMX_PIPELINE_DEPTH: the sharded update needs this "
+                "step's dc-tier result before the optimizer can run; "
+                "disable one of the two")
         if not isinstance(sync, FSA):
             # fail loudly: a user "running MultiGPS" must not silently get
             # a replicated update (VERDICT r1 weak #2)
@@ -216,7 +227,8 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             params, sync_state = sync.sync_params(params, sync_state, step)
-        model_state = sync.sync_model_state(model_state, step)
+        model_state, sync_state = sync.sync_model_state(model_state,
+                                                        sync_state, step)
 
         acc = jnp.mean(jnp.argmax(logits, -1) == yb)
         metrics = {"loss": loss, "accuracy": acc}

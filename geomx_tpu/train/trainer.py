@@ -9,7 +9,7 @@ its JSON measurement reporter (examples/utils.py:120-192).
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -21,7 +21,7 @@ from geomx_tpu.data.loader import GeoDataLoader
 from geomx_tpu.sync import get_sync_algorithm
 from geomx_tpu.sync.base import SyncAlgorithm
 from geomx_tpu.topology import HiPSTopology
-from geomx_tpu.train.state import TrainState, replicate_tree, unreplicate_tree
+from geomx_tpu.train.state import TrainState, replicate_tree
 from geomx_tpu.train.step import build_eval_step, build_train_step, make_loss_fn
 from geomx_tpu.utils.metrics import Measure
 
@@ -69,6 +69,7 @@ class Trainer:
         self.eval_step, self._logits_fn = build_eval_step(
             self._sd_model.apply)
         self._batch_sharding = topology.batch_sharding(self.mesh)
+        self._drain_step = None       # lazily-built pipeline drain program
         self._epoch_runners: dict = {}
         self._eval_cache: dict = {}    # device-resident test set
         self._eval_sweeps: dict = {}   # batch_size -> scanned eval program
@@ -91,10 +92,11 @@ class Trainer:
             # every (dc, worker) slot then tracks only its own shard
             mixed = self._mgps.mixed_example(params)
             opt_state = self.tx.init(mixed)
-            sync_state = self.sync.init_state(mixed)
+            sync_state = self.sync.init_state(mixed, model_state=model_state)
         else:
             opt_state = self.tx.init(params)
-            sync_state = self.sync.init_state(params)
+            sync_state = self.sync.init_state(params,
+                                              model_state=model_state)
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params, opt_state=opt_state,
@@ -139,6 +141,49 @@ class Trainer:
                              split_by_class=split_by_class, seed=seed,
                              sharding=sharding, augment=augment,
                              device_cache=device_cache)
+
+    def drain_pipeline(self, state: TrainState) -> TrainState:
+        """Apply a pipelined sync algorithm's completed in-flight dc-tier
+        aggregate without feeding a new batch (sync/pipeline.py): with
+        ``GEOMX_PIPELINE_DEPTH=1`` the last launched collectives have not
+        been applied when training stops — call this after the final
+        ``fit`` (before export/eval) so the last batch's gradient AND its
+        model-state (BatchNorm) aggregate land.  The mirror of the
+        pipeline's warmup bubble (the first step applies a zero aggregate
+        while the buffer fills).  No-op for synchronous algorithms; the
+        drained gradient buffer is zeroed (a subsequent ``fit`` warms up
+        again) and the model-state buffer keeps the applied value, the
+        same seeding a fresh init gets."""
+        sync = self.sync
+        if not hasattr(sync, "drain_grads"):
+            return state
+        if self._drain_step is None:
+            from geomx_tpu.parallel.collectives import shard_map_compat
+            from geomx_tpu.train.state import state_specs
+            tx = self.tx
+
+            def _drain(st):
+                squeeze = lambda t: jax.tree.map(lambda a: a[0, 0], t)
+                expand = lambda t: jax.tree.map(lambda a: a[None, None], t)
+                params = squeeze(st.params)
+                opt_state = squeeze(st.opt_state)
+                model_state = squeeze(st.model_state)
+                sync_state = squeeze(st.sync_state)
+                # no collectives: the buffers already hold reduced values
+                g, sync_state = sync.drain_grads(params, sync_state)
+                updates, opt_state = tx.update(g, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                model_state, sync_state = sync.drain_model_state(
+                    model_state, sync_state)
+                return TrainState(step=st.step, params=expand(params),
+                                  opt_state=expand(opt_state),
+                                  model_state=expand(model_state),
+                                  sync_state=expand(sync_state))
+
+            specs = state_specs()
+            self._drain_step = jax.jit(shard_map_compat(
+                _drain, self.mesh, in_specs=(specs,), out_specs=specs))
+        return self._drain_step(state)
 
     def predict_logits(self, state: TrainState, x: np.ndarray,
                        batch_size: int = 512) -> np.ndarray:
@@ -272,6 +317,14 @@ class Trainer:
           epoch as one scanned device program: per-iteration logging
           coarsens to per-epoch (mean loss/acc over the epoch), eval still
           runs between epochs.
+
+        Pipelined sync (``GEOMX_PIPELINE_DEPTH=1``): the first step from
+        a fresh state is the warmup bubble (a zero aggregate applies
+        while the pipeline fills) and one aggregate stays in flight when
+        fit returns — call ``drain_pipeline`` after the final fit to land
+        it.  Both the bubble and the in-flight buffer live in
+        ``sync_state``, so a checkpointed run resumes mid-pipeline with
+        no re-warmup.
 
         Returns (state, list of record dicts).
         """

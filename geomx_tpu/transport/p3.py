@@ -23,7 +23,7 @@ import dataclasses
 import heapq
 import itertools
 import threading
-from typing import Any, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 

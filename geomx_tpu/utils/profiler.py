@@ -100,6 +100,22 @@ class Profiler:
                 "tid": threading.get_ident() % 100000,
             })
 
+    def counter(self, name: str, values: Dict[str, float],
+                category: str = "host"):
+        """Chrome-trace counter sample (ph "C"): a named value track.
+        The pipelined sync engine (sync/pipeline.py) samples
+        ``<axis>_pipeline_inflight`` {bytes} here so the trace shows the
+        WAN payload parked between its launch span and the next step's
+        apply span."""
+        if not self.running:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": category, "ph": "C",
+                "ts": self._now_us(), "pid": os.getpid(),
+                "args": dict(values),
+            })
+
     @contextlib.contextmanager
     def scope(self, name: str, category: str = "host",
               args: Optional[Dict] = None):

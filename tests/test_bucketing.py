@@ -15,8 +15,7 @@ from jax.sharding import PartitionSpec as P
 from geomx_tpu.compression import (BiSparseCompressor, BucketedCompressor,
                                    FP16Compressor, GradientBucketer,
                                    MPQCompressor, NoCompressor,
-                                   TwoBitCompressor, get_compressor,
-                                   maybe_bucketed)
+                                   TwoBitCompressor, maybe_bucketed)
 from geomx_tpu.parallel.collectives import shard_map_compat
 from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
 
@@ -259,6 +258,24 @@ def test_fsa_buckets_dc_tier_by_default():
     assert isinstance(MixedSync(bucket_bytes=0).dc_compressor, NoCompressor)
     # worker tier stays per-leaf
     assert isinstance(FSA().worker_compressor, NoCompressor)
+
+
+def test_hfa_buckets_global_delta_by_default():
+    """HFA's K1*K2 global-delta allreduce crosses the same WAN hop as
+    FSA's gradients and gets the same fused-bucket default; tree-level
+    DGT (the hfa_dgt bench config) must still never double-wrap."""
+    from geomx_tpu.sync import HFA, DGTCompressor
+    assert isinstance(HFA().dc_compressor, BucketedCompressor)
+    assert isinstance(HFA(bucket_bytes=0).dc_compressor, NoCompressor)
+    dgt = DGTCompressor()
+    assert HFA(dc_compressor=dgt).dc_compressor is dgt
+    # config plumbing: GEOMX_BUCKET_BYTES reaches the HFA delta tier
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.sync import get_sync_algorithm
+    sync = get_sync_algorithm(GeoConfig(sync_mode="hfa",
+                                        bucket_bytes=1 << 16))
+    assert isinstance(sync.dc_compressor, BucketedCompressor)
+    assert sync.dc_compressor.bucket_bytes == 1 << 16
 
 
 def test_bucket_env_opt_out(monkeypatch):

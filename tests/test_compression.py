@@ -206,7 +206,6 @@ def _run_dc_allreduce(comp, g_per_party, topo, mesh):
         return out[None, None], jax.tree.map(lambda a: a[None, None], st2)
 
     # broadcast state to replica axes
-    import numpy as onp
     from geomx_tpu.train.state import replicate_tree
     st_rep = replicate_tree(state, topo, mesh)
     g_rep = jnp.broadcast_to(

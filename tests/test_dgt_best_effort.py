@@ -12,7 +12,6 @@ lost.
 """
 
 import numpy as np
-import pytest
 
 from geomx_tpu.service import GeoPSClient, GeoPSServer
 

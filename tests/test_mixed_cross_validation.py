@@ -9,7 +9,6 @@ the other's held.
 import threading
 
 import numpy as np
-import pytest
 
 
 def _make_problem(n=1024, d=32, classes=5, seed=0):

@@ -16,7 +16,6 @@ import pytest
 from geomx_tpu.config import GeoConfig
 from geomx_tpu.models import MLP
 from geomx_tpu.sync import FSA, HFA
-from geomx_tpu.topology import HiPSTopology
 from geomx_tpu.train import Trainer
 
 BOUND = 512  # demo-scale bigarray_bound: the MLP hidden matrix exceeds it

@@ -10,7 +10,6 @@ preserves send order) proves the interleaving.
 """
 
 import numpy as np
-import pytest
 
 from geomx_tpu.service import GeoPSClient, GeoPSServer
 

@@ -81,7 +81,6 @@ def test_replayed_inflight_push_not_double_merged():
 def test_round_completes_past_dead_waiting_pull():
     """A crashed worker parked in waiting_pulls must not prevent the
     round from completing for the live workers."""
-    import threading
     import time
 
     server = GeoPSServer(num_workers=2, mode="sync", accumulate=True).start()

@@ -6,7 +6,6 @@ reference exercises exactly this combination in its transport testing
 (SURVEY.md §4).
 """
 
-import os
 import time
 
 import numpy as np

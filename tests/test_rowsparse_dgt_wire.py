@@ -11,7 +11,6 @@ Parity targets:
 """
 
 import numpy as np
-import pytest
 
 from geomx_tpu.service import GeoPSClient, GeoPSServer
 

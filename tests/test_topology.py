@@ -1,5 +1,4 @@
 import jax
-import numpy as np
 import pytest
 
 from geomx_tpu.topology import HiPSTopology, DC_AXIS, WORKER_AXIS

@@ -4,7 +4,6 @@ ops/failure-detection utilities."""
 import threading
 
 import numpy as np
-import pytest
 
 from geomx_tpu.transport import P3Slicer, PrioritySendQueue, TSEngineScheduler
 from geomx_tpu.transport.tsengine import STOP

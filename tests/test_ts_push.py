@@ -10,7 +10,6 @@ machinery between local servers and the global tier.
 """
 
 import numpy as np
-import pytest
 
 from geomx_tpu.service import GeoPSClient, GeoPSServer
 from geomx_tpu.transport.tsengine import TSEngineScheduler

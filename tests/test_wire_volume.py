@@ -52,6 +52,50 @@ def test_wire_bytes_match_actual_payloads():
     assert mpq.wire_bytes_leaf(leaf) == 2 * bsc.k_for(n) * 4  # bsc route
 
 
+def test_pipelined_wire_accounting_matches_fsa_shifted():
+    """Pipelined mode moves the SAME bytes per step as synchronous FSA —
+    the payload is just applied one step late.  The accounting must
+    report the wrapped compressor's bytes unchanged, and the allreduce
+    must visibly shift the aggregates by exactly one call."""
+    from geomx_tpu.compression import BucketedCompressor, get_compressor
+    from geomx_tpu.sync.pipeline import PipelinedCompressor
+
+    tree = {"a": jnp.ones((3000,), jnp.float32),
+            "b": jnp.full((513,), 2.0, jnp.float32)}
+
+    for spec in ("none", "fp16", "2bit,0.5", "bsc,0.05", "mpq,0.05"):
+        wrapped = BucketedCompressor(get_compressor(spec), 1 << 20)
+        piped = PipelinedCompressor(
+            BucketedCompressor(get_compressor(spec), 1 << 20))
+        # bytes per step identical, one step shifted
+        assert piped.wire_bytes(tree) == wrapped.wire_bytes(tree), spec
+        for leaf in tree.values():
+            assert (piped.wire_bytes_leaf(leaf)
+                    == wrapped.wire_bytes_leaf(leaf)), spec
+
+    # the shift itself: call k applies call k-1's aggregate (axis size 1
+    # makes the "collective" the identity, so values compare directly)
+    piped = PipelinedCompressor(
+        BucketedCompressor(get_compressor("none"), 1 << 20))
+    ref = BucketedCompressor(get_compressor("none"), 1 << 20)
+    state = piped.init_state(tree)
+    g1 = tree
+    g2 = jax.tree.map(lambda x: x * -3.0, tree)
+    out1, state = piped.allreduce(g1, state, "x", 1)
+    for leaf in jax.tree.leaves(out1):
+        assert np.all(np.asarray(leaf) == 0.0)  # warmup bubble
+    out2, state = piped.allreduce(g2, state, "x", 1)
+    expect1, _ = ref.allreduce(g1, ref.init_state(tree), "x", 1)
+    for got, exp in zip(jax.tree.leaves(out2), jax.tree.leaves(expect1)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp))
+
+    # the in-flight buffer lives on the bucket layout (flat fp32), so
+    # checkpointed wire state and error feedback share coordinates
+    bk = piped.inner._bucketer(jax.tree.leaves(tree))
+    assert [b.shape for b in state["inflight"]] == [
+        (n,) for n in bk.bucket_sizes]
+
+
 def test_dgt_amortized_accounting_matches_schedule():
     """DGT's reported (k*(f-1)+1)/f amortized fraction is the real
     send/drain schedule: non-drain steps leave the deferred blocks in
