@@ -44,6 +44,16 @@ Micro-modes:
       the DCE-verified count of dc collectives the weight update waits
       on (0 under pipelining), and the modeled step time / overlap ratio
       under an injected DCN delay.  CPU, no TPU needed.
+  bench.py --compare-resilience [--model=resnet20] [--steps=9]
+           [--schedule="seed=1234;blackout@3:party=1,steps=3"]
+           [--compression=none] [--pipeline-depth=0]
+      One JSON line replaying a seeded chaos schedule (party blackout +
+      re-admission) on a 2-party CPU mesh: the run completes without
+      stalling, degraded steps apply the renormalized survivor mean
+      (bit-exact vs a single-party run + step-metadata live count), the
+      re-admission catch-up payload is measured, and the party count /
+      WAN wire-volume accounting return to pre-failure values.  CPU, no
+      TPU needed (docs/resilience.md).
 
 Env knobs:
   GEOMX_BENCH_PLATFORM=cpu   debug on the host CPU (tiny shapes)
@@ -1152,6 +1162,228 @@ def compare_pipeline_main(argv):
 
 
 # --------------------------------------------------------------------------
+# --compare-resilience: seeded mid-run party blackout + re-admission
+# --------------------------------------------------------------------------
+
+
+def _compare_resilience(model_name: str = "resnet20",
+                        compression: str = "none", batch: int = 32,
+                        steps: int = 9, schedule_spec: str = None,
+                        pipeline_depth: int = 0):
+    """The resilience acceptance run: a seeded chaos schedule blacks out
+    party 1 mid-run on a 2-party CPU mesh; the run must complete without
+    stalling, the degraded steps must apply the renormalized survivor
+    mean (verified two ways: the step metadata's static live-party
+    count, and a bit-exact comparison of one degraded step against a
+    single-party run from the same state), and after re-admission the
+    party count and per-step WAN wire-volume accounting must return to
+    their pre-failure values.  The re-admitted party's catch-up payload
+    (checkpoint-format state broadcast) is measured in bytes."""
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.resilience import (ChaosEngine, ChaosSchedule,
+                                      PartyLivenessController)
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "compare-resilience needs >= 2 devices for the dc axis (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    # from_env so GEOMX_CHAOS_SCHEDULE / GEOMX_RESILIENCE_* apply; the
+    # mode's own axes are pinned (sync_mode stays fsa — the solo
+    # reference in _verify_survivor_mean is an FSA run)
+    cfg = GeoConfig.from_env(num_parties=2, workers_per_party=1,
+                             sync_mode="fsa", compression=compression,
+                             pipeline_depth=pipeline_depth)
+    if schedule_spec is None:
+        # precedence: --schedule > GEOMX_CHAOS_SCHEDULE (via the config)
+        # > the seeded default (party 1 dies at step 3, returns at 6)
+        env_sched = ChaosSchedule.from_config(cfg)
+        schedule = env_sched if env_sched is not None else \
+            ChaosSchedule.from_spec("seed=1234;blackout@3:party=1,steps=3")
+    else:
+        schedule = ChaosSchedule.from_spec(schedule_spec)
+    if schedule.last_step >= steps:
+        raise ValueError(
+            f"--steps={steps} ends before the schedule's last event "
+            f"(step {schedule.last_step}); raise --steps")
+    sync = get_sync_algorithm(cfg)
+    trainer = Trainer(get_model(model_name, num_classes=10), topo,
+                      optax.sgd(0.1, momentum=0.9), sync=sync, config=cfg,
+                      donate=False)
+    local_b = max(1, batch // 2)
+    rng = np.random.RandomState(0)
+    # parties get DIFFERENT data so the renormalized survivor mean is a
+    # real claim, not an identity
+    x = (rng.rand(2, 1, local_b, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(2, 1, local_b)).astype(np.int32)
+    sharding = topo.batch_sharding(trainer.mesh)
+    xb = jax.device_put(x, sharding)
+    yb = jax.device_put(y, sharding)
+    state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+
+    def wan_bytes_per_step(num_live):
+        # per-party dc-tier payload x live parties actually transmitting
+        comp = sync.dc_compressor if pipeline_depth == 0 \
+            else sync.inner.dc_compressor
+        params = jax.tree.map(lambda a: a[0, 0], state.params)
+        return int(comp.wire_bytes(params)) * num_live
+
+    controller = PartyLivenessController.from_config(cfg)
+    timeline = []
+    epochs_log = []
+    catchup_bytes = None
+    degraded_check = None
+    current = controller.epoch
+    with ChaosEngine(schedule, controller) as engine:
+        for step in range(steps):
+            fired = engine.tick(step)
+            ep = controller.epoch
+            if ep.version != current.version:
+                readmitting = ep.num_live > current.num_live
+                if readmitting:
+                    # what the survivors broadcast to the returning
+                    # party before the mask widens back over it
+                    catchup_bytes = len(trainer.catchup_payload(state))
+                state = trainer.apply_membership(state, ep)
+                epochs_log.append({"step": step, "version": ep.version,
+                                   "live_mask": list(ep.live_mask),
+                                   "events": [e.kind for e in fired]})
+                current = ep
+                # the solo-run cross-check only holds for the lossless
+                # path: a 1-party reference short-circuits the dc
+                # compressor (axis size 1), so under lossy compression
+                # the two runs differ by the compression error itself,
+                # not by the membership algebra (which
+                # tests/test_resilience.py proves bit-exact in-program)
+                if not ep.all_live and degraded_check is None \
+                        and pipeline_depth == 0 and compression == "none":
+                    degraded_check = _verify_survivor_mean(
+                        trainer, state, x, y, model_name)
+            state, metrics = trainer.train_step(state, xb, yb)
+            timeline.append({
+                "step": step,
+                "num_live": float(metrics["num_live_parties"]),
+                "loss": round(float(metrics["loss"]), 5),
+                "wan_bytes": wan_bytes_per_step(ep.num_live)})
+    jax.block_until_ready(jax.tree.leaves(state.params)[0])
+    # the replicas must agree after the full blackout/readmit cycle
+    leaf = np.asarray(jax.device_get(jax.tree.leaves(state.params)[0]))
+    replicas_consistent = bool(np.array_equal(leaf[0, 0], leaf[1, 0]))
+
+    pre = timeline[0]
+    post = timeline[-1]
+    degraded_steps = [t for t in timeline if t["num_live"] < 2]
+    out = {
+        "mode": "compare_resilience",
+        "model": model_name, "compression": compression,
+        "pipeline_depth": pipeline_depth, "batch": batch, "steps": steps,
+        "schedule": schedule.spec(),
+        "membership_epochs": epochs_log,
+        "timeline": timeline,
+        "completed_without_stall": len(timeline) == steps,
+        "degraded_steps": len(degraded_steps),
+        "degraded_num_live": ([t["num_live"] for t in degraded_steps][:1]
+                              or [None])[0],
+        "catchup_bytes": catchup_bytes,
+        "replicas_consistent_after_cycle": replicas_consistent,
+        "party_count_restored": post["num_live"] == pre["num_live"],
+        "wire_volume_restored": post["wan_bytes"] == pre["wan_bytes"],
+    }
+    if degraded_check is not None:
+        out.update(degraded_check)
+    return out
+
+
+def _verify_survivor_mean(trainer, state, x, y, model_name):
+    """One degraded step vs a single-party run from the SAME state and
+    the survivor's batch: under the live mask (True, False) both must
+    produce the survivor-mean update.  The masked AGGREGATE itself is
+    bit-exact (tests/test_resilience.py proves it inside one program);
+    across the two differently-compiled programs here XLA may
+    reassociate reductions by an ulp, so the check tolerates float32
+    rounding and records the max deviation.  Also records the
+    dc-collective count in the degraded step's traced jaxpr (the
+    collective is still present; the mask renormalizes it)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.models import get_model
+    from geomx_tpu.sync import FSA
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+    from geomx_tpu.train.state import unreplicate_tree
+
+    sharding = trainer.topology.batch_sharding(trainer.mesh)
+    xb = jax.device_put(x, sharding)
+    yb = jax.device_put(y, sharding)
+    structure = _dc_weight_path_analysis(trainer.train_step, state, xb, yb)
+    s_deg, m_deg = trainer.train_step(state, xb, yb)
+
+    host = jax.tree.map(lambda a: np.asarray(jax.device_get(a))[0, 0],
+                        (state.params, state.opt_state, state.model_state))
+    topo1 = HiPSTopology(num_parties=1, workers_per_party=1)
+    solo = Trainer(get_model(model_name, num_classes=10), topo1,
+                   optax.sgd(0.1, momentum=0.9), sync=FSA(), donate=False)
+    from geomx_tpu.train.state import TrainState, replicate_tree
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    p, o, ms = host
+    solo_state = TrainState(
+        step=jax.device_put(jnp.asarray(0, jnp.int32),
+                            NamedSharding(solo.mesh, PartitionSpec())),
+        params=replicate_tree(p, topo1, solo.mesh),
+        opt_state=replicate_tree(o, topo1, solo.mesh),
+        model_state=replicate_tree(ms, topo1, solo.mesh),
+        sync_state=replicate_tree(
+            solo.sync.init_state(p, model_state=ms), topo1, solo.mesh))
+    sh1 = topo1.batch_sharding(solo.mesh)
+    s_solo, m_solo = solo.train_step(
+        solo_state, jax.device_put(x[:1], sh1), jax.device_put(y[:1], sh1))
+
+    pd = unreplicate_tree(s_deg.params)
+    ps = unreplicate_tree(s_solo.params)
+    max_diff = max((float(np.max(np.abs(a - b))) if a.size else 0.0)
+                   for a, b in zip(jax.tree.leaves(pd),
+                                   jax.tree.leaves(ps)))
+    close = all(np.allclose(a, b, rtol=1e-6, atol=1e-8)
+                for a, b in zip(jax.tree.leaves(pd), jax.tree.leaves(ps)))
+    return {"degraded_matches_survivor_mean": bool(close),
+            "survivor_mean_max_abs_diff": max_diff,
+            "degraded_dc_collectives_total":
+                structure.get("dc_collectives_total"),
+            "degraded_loss_vs_solo": [round(float(m_deg["loss"]), 6),
+                                      round(float(m_solo["loss"]), 6)]}
+
+
+def compare_resilience_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--compression="):
+            kwargs["compression"] = a.split("=", 1)[1]
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--steps="):
+            kwargs["steps"] = int(a.split("=", 1)[1])
+        elif a.startswith("--schedule="):
+            kwargs["schedule_spec"] = a.split("=", 1)[1]
+        elif a.startswith("--pipeline-depth="):
+            kwargs["pipeline_depth"] = int(a.split("=", 1)[1])
+    _emit(_compare_resilience(**kwargs))
+
+
+# --------------------------------------------------------------------------
 # parent: watchdog + single-line aggregation
 # --------------------------------------------------------------------------
 
@@ -1286,6 +1518,14 @@ def _has_failures(results, error):
         return True
     return any(results[k] is not None and not _unit_ok(results[k])
                for k in _RESUMABLE)
+
+
+def _resume_clears_error(results, r_ok, r_err):
+    """Whether a finished resume attempt justifies clearing the record's
+    top-level error: only when the attempt itself was clean AND no
+    recorded unit still carries a failure — a resume that re-ran some
+    units while others kept their errors must not report success."""
+    return bool(r_ok) and r_err is None and not _has_failures(results, None)
 
 
 def _aggregate(results, error, attempt_log, partial):
@@ -1432,8 +1672,8 @@ def parent_main():
         attempt_log.append({"attempt": f"resume{i + 1}",
                             "init_ok": r_ok, "error": r_err})
         init_ok = init_ok or r_ok
-        if r_ok and r_err is None:
-            error = None  # the re-run units are now good
+        if _resume_clears_error(results, r_ok, r_err):
+            error = None  # the resume was clean and every unit is good
         # a FAILED resume must not downgrade the record: whatever the
         # first attempt established keeps its error state (the failed
         # resume is on the attempt log), so resume only ever improves
@@ -1442,7 +1682,17 @@ def parent_main():
 
 
 def main():
-    if "--compare-pipeline" in sys.argv:
+    if "--compare-resilience" in sys.argv:
+        # chaos/structure micro-mode like --compare-pipeline: in-process
+        # on the CPU backend with a 2-device virtual mesh
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2").strip()
+        compare_resilience_main(sys.argv[1:])
+    elif "--compare-pipeline" in sys.argv:
         # accounting/structure micro-mode like --compare-bucketing:
         # in-process on the CPU backend with a 2-device virtual mesh
         os.environ.setdefault("JAX_PLATFORMS",

@@ -19,7 +19,11 @@ re-built TPU-first:
 Beyond the reference's scope: long-context sequence parallelism — ring
 attention (``parallel.ring_attention``) and Ulysses all-to-all
 (``parallel.ulysses``) over a third "sp" mesh axis
-(``HiPSTopology(sp_degree=n)``), first-class through the Trainer.
+(``HiPSTopology(sp_degree=n)``), first-class through the Trainer — and
+elastic resilience (``resilience``): versioned party-membership epochs,
+degraded-mode WAN sync that renormalizes the dc-tier mean over surviving
+parties, re-admission catch-up, and a deterministic seeded chaos harness
+(docs/resilience.md).
 
 Synchronization algorithms: FSA (fully-synchronous, default), MixedSync
 (async global tier with optional DCASGD delay compensation), and HFA

@@ -116,6 +116,17 @@ class GeoConfig:
     heartbeat_interval_s: float = 0.0  # PS_HEARTBEAT_INTERVAL; 0 disables
     heartbeat_timeout_s: float = 15.0  # PS_HEARTBEAT_TIMEOUT
 
+    # ---- resilience (resilience/: membership epochs, degraded-mode sync,
+    # deterministic chaos; docs/resilience.md)
+    # residual policy at a membership change: "reset" re-initializes
+    # dc-tier compressor residuals / pipeline buffers, "carry" keeps them
+    resilience_residuals: str = "reset"
+    # floor for the PartyLivenessController: a transition leaving fewer
+    # live parties raises instead of publishing an unexecutable epoch
+    resilience_min_live: int = 1
+    # chaos schedule spec (resilience/chaos.py format); "" = no chaos
+    chaos_schedule: str = ""
+
     @classmethod
     def from_env(cls, **overrides) -> "GeoConfig":
         cfg = dict(
@@ -159,6 +170,11 @@ class GeoConfig:
                 ["GEOMX_HEARTBEAT_INTERVAL", "PS_HEARTBEAT_INTERVAL"], 0.0, float),
             heartbeat_timeout_s=_env(
                 ["GEOMX_HEARTBEAT_TIMEOUT", "PS_HEARTBEAT_TIMEOUT"], 15.0, float),
+            resilience_residuals=_env(
+                ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
+            resilience_min_live=_env(
+                ["GEOMX_RESILIENCE_MIN_LIVE"], 1, int),
+            chaos_schedule=_env(["GEOMX_CHAOS_SCHEDULE"], "", str),
         )
         cfg.update(overrides)
         return cls(**cfg)

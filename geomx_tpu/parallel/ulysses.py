@@ -28,6 +28,17 @@ from jax import lax
 from geomx_tpu.parallel.ring_attention import _block
 
 
+def _fused_block_aligned(seq_len: int) -> bool:
+    """Mirror of ring_attention's hop-block gate for the post-all_to_all
+    full sequence: the flash kernel tiles the (padded) sequence in
+    blocks of ``min(128, L)``, and Mosaic needs that block sublane-
+    aligned (f32 tile = 8 sublanes).  L >= 128 always tiles at 128;
+    shorter sequences pass only when the padded block (= L itself) is
+    8-aligned — otherwise the jnp streaming path, which works for any
+    shape, must serve."""
+    return min(128, seq_len) % 8 == 0
+
+
 def _streaming_attention(q, k, v, causal: bool,
                          block: int = 1024) -> jax.Array:
     """Full-sequence attention with a flash-style streaming softmax over
@@ -95,9 +106,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          f"sequence axis size ({n})")
     if use_fused is None:
         from geomx_tpu.ops.flash_attention import fused_attention_supported
-        # D alignment mirrors ring_attention's gate: Mosaic needs the
-        # head dim sublane/lane-aligned (flash_attention pads only L)
-        use_fused = fused_attention_supported() and D % 8 == 0
+        # both alignments mirror ring_attention's auto-gate: Mosaic
+        # needs the head dim lane-aligned AND the kernel's seq block
+        # sublane-aligned.  The fused call sees the FULL sequence
+        # (Lq * n after the all_to_all), so the gate checks the padded
+        # block of that length; misaligned shapes fall back to
+        # _streaming_attention (explicit use_fused=True overrides)
+        use_fused = (fused_attention_supported() and D % 8 == 0
+                     and _fused_block_aligned(Lq * n))
 
     # ONE all_to_all for q/k/v stacked: [3, B, L/n, H, D] -> [3, B, L,
     # H/n, D] — each device trades its sequence shard of every head for

@@ -1,0 +1,246 @@
+"""Deterministic fault injection: seeded chaos schedules.
+
+The reference injects faults with one global knob — ``PS_DROP_MSG``
+drops N% of received data messages (van.cc:510-512), which our host
+plane mirrors in ``service/protocol.should_drop``.  That is a *rate*,
+not a *scenario*: it cannot express "party 1 goes dark at step 3 for 4
+steps, then a 30% loss epoch at step 10", and an unseeded rate is not
+reproducible.  This module turns failures into data:
+
+- :class:`ChaosSchedule` — a seeded, sorted list of
+  :class:`ChaosEvent`\\ s, built from a compact spec string
+  (``GEOMX_CHAOS_SCHEDULE``), from code, or sampled reproducibly with
+  :meth:`ChaosSchedule.random`;
+- :class:`ChaosEngine` — replays the schedule in-process against a
+  :class:`~geomx_tpu.resilience.liveness.PartyLivenessController`
+  (party blackouts / link flaps -> membership epochs) and against the
+  existing ``should_drop`` hook (drop-rate epochs override
+  ``GEOMX_DROP_MSG`` for a window of steps).
+
+Spec format (semicolon-separated events; see docs/resilience.md):
+
+    seed=<n>                       optional, reseeds the shared drop RNG
+    blackout@<step>:party=<p>[,steps=<n>]   party dies (auto-readmit
+                                            after n steps when given)
+    flap@<step>:party=<p>[,steps=<n>]       short blackout, default 1 step
+    readmit@<step>:party=<p>                explicit re-admission
+    drop@<step>:rate=<pct>[,steps=<n>]      message-drop epoch (host
+                                            transports; cleared after n)
+
+Example: ``"seed=7;blackout@3:party=1,steps=4;drop@10:rate=30,steps=5"``.
+
+Determinism contract: the same spec (or the same ``random`` arguments)
+produces the same event sequence, and the engine reseeds the protocol
+drop RNG from the schedule seed, so a chaos run is replayable bit for
+bit — the property every resilience test and
+``bench.py --compare-resilience`` stands on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _random
+from typing import Iterable, List, Optional, Tuple
+
+# event kinds after duration expansion (a blackout/flap/drop WITH a
+# ``steps=`` window expands into its paired restore event at build time,
+# so the engine itself is a stateless replayer)
+_KINDS = ("blackout", "readmit", "drop_rate", "drop_clear")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ChaosEvent:
+    step: int
+    kind: str          # one of _KINDS
+    party: int = -1    # blackout/readmit
+    rate: int = 0      # drop_rate, percent 0-100
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown chaos event kind {self.kind!r}; "
+                             f"valid: {_KINDS}")
+        if self.step < 0:
+            raise ValueError(f"chaos event step must be >= 0 ({self.step})")
+
+
+class ChaosSchedule:
+    """An immutable, step-sorted sequence of chaos events plus the seed
+    that makes drop-rate epochs reproducible."""
+
+    def __init__(self, events: Iterable[ChaosEvent], seed: int = 0):
+        self.events: Tuple[ChaosEvent, ...] = tuple(sorted(events))
+        self.seed = int(seed)
+
+    def events_at(self, step: int) -> List[ChaosEvent]:
+        return [e for e in self.events if e.step == step]
+
+    @property
+    def last_step(self) -> int:
+        return max((e.step for e in self.events), default=-1)
+
+    def spec(self) -> str:
+        """Canonical spec string (round-trips through ``from_spec``) —
+        what the bench record and test failures print."""
+        parts = [f"seed={self.seed}"]
+        for e in self.events:
+            if e.kind in ("blackout", "readmit"):
+                parts.append(f"{e.kind}@{e.step}:party={e.party}")
+            elif e.kind == "drop_rate":
+                parts.append(f"drop@{e.step}:rate={e.rate}")
+            else:  # drop_clear
+                parts.append(f"dropclear@{e.step}")
+        return ";".join(parts)
+
+    # ---- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, cfg) -> "Optional[ChaosSchedule]":
+        """The ``GEOMX_CHAOS_SCHEDULE`` consumption point: parse the
+        config's schedule spec, or None when no chaos is configured."""
+        spec = getattr(cfg, "chaos_schedule", "") or ""
+        return cls.from_spec(spec) if spec.strip() else None
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ChaosSchedule":
+        """Parse the ``GEOMX_CHAOS_SCHEDULE`` format (module docstring)."""
+        events: List[ChaosEvent] = []
+        seed = 0
+        for raw in filter(None, (s.strip() for s in spec.split(";"))):
+            if raw.startswith("seed="):
+                seed = int(raw.split("=", 1)[1])
+                continue
+            if "@" not in raw:
+                raise ValueError(f"bad chaos event {raw!r}: expected "
+                                 "kind@step[:key=val,...]")
+            head, _, tail = raw.partition(":")
+            kind, step_s = head.split("@", 1)
+            step = int(step_s)
+            kv = {}
+            for item in filter(None, (t.strip() for t in tail.split(","))):
+                k, _, v = item.partition("=")
+                if not _:
+                    raise ValueError(f"bad chaos option {item!r} in {raw!r}")
+                kv[k] = int(v)
+            known = {"blackout": {"party", "steps"},
+                     "flap": {"party", "steps"},
+                     "readmit": {"party"},
+                     "drop": {"rate", "steps"},
+                     "dropclear": set()}
+            if kind not in known:
+                raise ValueError(f"unknown chaos kind {kind!r}; valid: "
+                                 f"{sorted(known)}")
+            if set(kv) - known[kind]:
+                raise ValueError(f"chaos {kind!r} does not take "
+                                 f"{sorted(set(kv) - known[kind])}")
+            if kind in ("blackout", "flap"):
+                party = kv["party"]
+                events.append(ChaosEvent(step, "blackout", party=party))
+                # a flap is a short blackout; both auto-readmit when a
+                # window is given (flap defaults to one step)
+                steps = kv.get("steps", 1 if kind == "flap" else 0)
+                if steps:
+                    events.append(ChaosEvent(step + steps, "readmit",
+                                             party=party))
+            elif kind == "readmit":
+                events.append(ChaosEvent(step, "readmit", party=kv["party"]))
+            elif kind == "drop":
+                rate = kv["rate"]
+                if not 0 <= rate <= 100:
+                    raise ValueError(f"drop rate {rate} not in [0, 100]")
+                events.append(ChaosEvent(step, "drop_rate", rate=rate))
+                if kv.get("steps"):
+                    events.append(ChaosEvent(step + kv["steps"],
+                                             "drop_clear"))
+            else:  # dropclear
+                events.append(ChaosEvent(step, "drop_clear"))
+        return cls(events, seed=seed)
+
+    @classmethod
+    def random(cls, seed: int, steps: int, num_parties: int,
+               blackouts: int = 1, blackout_len: Tuple[int, int] = (2, 5),
+               drop_epochs: int = 0,
+               drop_rate: Tuple[int, int] = (10, 50),
+               keep_party: int = 0) -> "ChaosSchedule":
+        """Sample a reproducible schedule: ``blackouts`` party outages
+        (never ``keep_party`` — someone must survive) and ``drop_epochs``
+        loss windows, all from ``random.Random(seed)`` so the same
+        arguments always produce the same scenario."""
+        if num_parties < 2 and blackouts:
+            raise ValueError("party blackouts need num_parties >= 2")
+        rng = _random.Random(seed)
+        events: List[ChaosEvent] = []
+        candidates = [p for p in range(num_parties) if p != keep_party]
+        for _ in range(blackouts):
+            party = rng.choice(candidates)
+            length = rng.randint(*blackout_len)
+            start = rng.randint(1, max(1, steps - length - 1))
+            events.append(ChaosEvent(start, "blackout", party=party))
+            events.append(ChaosEvent(start + length, "readmit", party=party))
+        for _ in range(drop_epochs):
+            start = rng.randint(1, max(1, steps - 2))
+            length = rng.randint(1, max(1, steps - start - 1))
+            events.append(ChaosEvent(start, "drop_rate",
+                                     rate=rng.randint(*drop_rate)))
+            events.append(ChaosEvent(start + length, "drop_clear"))
+        return cls(events, seed=seed)
+
+
+class ChaosEngine:
+    """Replays a schedule against the liveness controller and the
+    ``should_drop`` hook.  Call :meth:`tick` once per training step
+    (before running the step); it returns the events applied so the
+    caller can react (rebind membership, log, assert)."""
+
+    def __init__(self, schedule: ChaosSchedule,
+                 controller: Optional[object] = None,
+                 drive_drop_hook: bool = True):
+        self.schedule = schedule
+        self.controller = controller
+        self.drive_drop_hook = drive_drop_hook
+        self._applied_through = -1
+        if drive_drop_hook:
+            # reproducibility: the message-loss pattern inside a drop
+            # epoch derives from the schedule seed, not process history
+            from geomx_tpu.service.protocol import reseed_drop_rng
+            reseed_drop_rng(schedule.seed)
+
+    def tick(self, step: int) -> List[ChaosEvent]:
+        """Apply every event scheduled in ``(last_tick, step]`` (skipped
+        steps still fire — a caller that advances by epochs must not
+        silently lose a mid-epoch blackout)."""
+        if step <= self._applied_through:
+            return []
+        fired = [e for e in self.schedule.events
+                 if self._applied_through < e.step <= step]
+        self._applied_through = step
+        for e in fired:
+            self._apply(e)
+        return fired
+
+    def _apply(self, e: ChaosEvent) -> None:
+        if e.kind in ("blackout", "readmit"):
+            if self.controller is None:
+                raise ValueError(
+                    f"chaos event {e} needs a PartyLivenessController "
+                    "(construct ChaosEngine(schedule, controller))")
+            if e.kind == "blackout":
+                self.controller.mark_dead(e.party)
+            else:
+                self.controller.mark_live(e.party)
+        elif self.drive_drop_hook:
+            from geomx_tpu.service.protocol import set_drop_rate_override
+            set_drop_rate_override(e.rate if e.kind == "drop_rate" else None)
+
+    def close(self) -> None:
+        """Clear any installed drop override (idempotent) — pair with
+        construction in tests so one chaos run cannot leak loss into the
+        next."""
+        if self.drive_drop_hook:
+            from geomx_tpu.service.protocol import set_drop_rate_override
+            set_drop_rate_override(None)
+
+    def __enter__(self) -> "ChaosEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -777,6 +777,15 @@ class GeoPSClient:
         self._key_rounds.update(prog)
         return prog
 
+    def evict_worker(self, node_id: int) -> int:
+        """Ask the server to evict a dead worker from the sync gate
+        (resilience/ — server-side eviction): the remaining workers'
+        rounds complete at the smaller count instead of stalling.
+        Returns the server's new num_workers."""
+        reply = self._request(Msg(MsgType.COMMAND, meta={
+            "cmd": "evict_worker", "node": int(node_id)}))
+        return int(reply.meta["num_workers"])
+
     # ---- TSEngine push-side overlay (ASK1 aggregation tree) ---------------
 
     def ts_push(self, key: str, grad: np.ndarray, num_merge: int = 1) -> None:

@@ -122,6 +122,24 @@ import random as _random
 
 _drop_rng = _random.Random(0xD209)
 
+# chaos drop-rate epochs (resilience/chaos.py): an in-process override
+# that takes precedence over GEOMX_DROP_MSG for a window of steps
+_drop_override: "int | None" = None
+
+
+def set_drop_rate_override(rate) -> None:
+    """Install (0-100) or clear (None) the in-process drop-rate
+    override.  The chaos engine uses this so loss epochs are scheduled
+    and reversible instead of leaking env state across tests."""
+    global _drop_override
+    _drop_override = None if rate is None else max(0, min(100, int(rate)))
+
+
+def reseed_drop_rng(seed: int) -> None:
+    """Reseed the shared drop RNG: a seeded chaos schedule reproduces
+    the exact message-loss pattern run to run."""
+    _drop_rng.seed(seed)
+
 
 def env_int(names, default: int) -> int:
     """First-set env var among `names` wins (shared config._env parser, so
@@ -131,7 +149,10 @@ def env_int(names, default: int) -> int:
 
 
 def drop_rate() -> int:
-    """Drop percentage from GEOMX_DROP_MSG / PS_DROP_MSG (0-100)."""
+    """Drop percentage: the chaos override when installed, else
+    GEOMX_DROP_MSG / PS_DROP_MSG (0-100)."""
+    if _drop_override is not None:
+        return _drop_override
     return max(0, min(100, env_int(("GEOMX_DROP_MSG", "PS_DROP_MSG"), 0)))
 
 

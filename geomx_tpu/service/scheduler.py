@@ -55,6 +55,11 @@ class GeoScheduler:
         self._next = {"server": KOFFSET, "worker": KOFFSET + 1,
                       "global_server": 8, "global_worker": 9}
         self._barriers: Dict[str, list] = {}
+        # roster epoch (resilience/): bumps on every membership-visible
+        # roster mutation — registration (fresh or recovery) and
+        # eviction — so liveness consumers can order roster snapshots
+        # and detect changes without diffing them
+        self._epoch = 0
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
 
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -164,16 +169,42 @@ class GeoScheduler:
                            if e[0] != node_id]
                 entries.append((node_id, host, port, tag))
                 self._roster[role] = sorted(entries)
+                self._epoch += 1
+                epoch = self._epoch
                 roster = {r: list(v) for r, v in self._roster.items()}
             self.heartbeats.heartbeat(node_id)
             self._reply(conn, msg, Msg(MsgType.ACK, meta={
                 "node_id": node_id, "is_recovery": bool(recovery),
-                "cluster": roster}))
+                "cluster": roster, "epoch": epoch}))
         elif cmd == "cluster":
             with self._lock:
                 roster = {r: list(v) for r, v in self._roster.items()}
+                epoch = self._epoch
             self._reply(conn, msg, Msg(MsgType.ACK,
-                                       meta={"cluster": roster}))
+                                       meta={"cluster": roster,
+                                             "epoch": epoch}))
+        elif cmd == "evict":
+            # operator/controller-driven removal (resilience/): take the
+            # node out of the roster AND the id table so discovery and
+            # liveness stop counting it; a later return re-registers as
+            # a fresh node (re-admission, not recovery)
+            node = int(msg.meta["node"])
+            with self._lock:
+                evicted = False
+                for role, entries in list(self._roster.items()):
+                    kept = [e for e in entries if e[0] != node]
+                    if len(kept) != len(entries):
+                        self._roster[role] = kept
+                        evicted = True
+                for k, v in list(self._assigned.items()):
+                    if v == node:
+                        del self._assigned[k]
+                if evicted:
+                    self._epoch += 1
+                epoch = self._epoch
+            self.heartbeats.unregister(node)
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={
+                "evicted": evicted, "epoch": epoch}))
         elif cmd == "barrier":
             group = str(msg.meta.get("group", ""))
             expect = int(msg.meta["expect"])
@@ -209,6 +240,7 @@ class SchedulerClient:
         self._lock = threading.Lock()
         self.node_id: Optional[int] = None
         self.is_recovery = False
+        self.roster_epoch = 0   # last roster epoch seen (resilience/)
         self._hb_stop: Optional[threading.Event] = None
         self._hb_sock: Optional[socket.socket] = None
 
@@ -230,11 +262,23 @@ class SchedulerClient:
             **({"prev_id": prev_id} if prev_id is not None else {})}))
         self.node_id = int(reply.meta["node_id"])
         self.is_recovery = bool(reply.meta["is_recovery"])
+        self.roster_epoch = int(reply.meta.get("epoch", 0))
         return reply.meta
 
     def cluster(self) -> dict:
-        return dict(self._rpc(Msg(MsgType.COMMAND,
-                                  meta={"cmd": "cluster"})).meta["cluster"])
+        reply = self._rpc(Msg(MsgType.COMMAND, meta={"cmd": "cluster"}))
+        self.roster_epoch = int(reply.meta.get("epoch", self.roster_epoch))
+        return dict(reply.meta["cluster"])
+
+    def evict(self, node_id: int) -> dict:
+        """Remove a node from the roster (resilience/): the scheduler
+        bumps the roster epoch and forgets the node's heartbeat identity.
+        Returns {"evicted": bool, "epoch": int}."""
+        reply = self._rpc(Msg(MsgType.COMMAND,
+                              meta={"cmd": "evict", "node": int(node_id)}))
+        self.roster_epoch = int(reply.meta.get("epoch", self.roster_epoch))
+        return {"evicted": bool(reply.meta.get("evicted")),
+                "epoch": self.roster_epoch}
 
     def wait_for(self, role: str, count: int, timeout: float = 60.0,
                  tag: Optional[str] = None) -> list:

@@ -126,6 +126,9 @@ class GeoPSServer:
         # arrival order of (sender, key, chunk) — TCP preserves the
         # client's send order, so tests/demos can assert P3 interleaving
         self.push_log: list = []
+        # sender ids removed from the sync gate (resilience/): guards
+        # against double-eviction shrinking the gate twice for one death
+        self._evicted: set = set()
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
         self.rank = rank
         self._conn_wlocks: Dict[int, threading.Lock] = {}
@@ -659,6 +662,14 @@ class GeoPSServer:
                 MsgType.ACK,
                 meta={"dead": self.heartbeats.dead_nodes(
                     msg.meta.get("timeout"))}))
+            return
+        elif cmd == "evict_worker":
+            # resilience/: un-stall the sync gate after a worker death
+            # (the liveness controller or an operator decides WHEN; the
+            # server only executes the roster change)
+            n = self.evict_worker(int(msg.meta["node"]))
+            self._reply(conn, msg, Msg(MsgType.ACK,
+                                       meta={"num_workers": n}))
             return
         elif cmd == "wire_stats":
             # this server process's Van-style byte/message counters
@@ -1268,47 +1279,93 @@ class GeoPSServer:
         st.pushed[msg.sender] = st.pushed.get(msg.sender, 0) + 1
         self._reply(conn, msg, Msg(MsgType.ACK, key=key))
         if st.count >= self.num_workers:
-            merged, st.merged, st.count = st.merged, None, 0
-            if st.rs_rows:
-                rows_u, vals_u = self._rs_unique(st.rs_rows, st.rs_vals)
-                st.rs_rows, st.rs_vals = [], []
-                if self._gclients:
-                    self._relay_enqueue(
-                        key, ((rows_u, vals_u), False, True, None))
-                    return
-                self._apply_row_sparse(key, rows_u, vals_u)
-                self._finish_round_locked(key, st)
-                return
+            self._complete_merge_locked(key, st)
+
+    def _complete_merge_locked(self, key: str, st: _KeyState):
+        """Close a full sync round for ``key``: apply or relay the merge
+        and finish the round.  Caller holds self._lock and has checked
+        ``st.count >= self.num_workers``.  Factored out of _push_locked
+        so worker eviction (resilience/) can close rounds the evicted
+        worker would otherwise stall forever."""
+        merged, st.merged, st.count = st.merged, None, 0
+        if st.rs_rows:
+            rows_u, vals_u = self._rs_unique(st.rs_rows, st.rs_vals)
+            st.rs_rows, st.rs_vals = [], []
             if self._gclients:
-                if self.hfa_k2 is not None:
-                    # HFA: `merged` is the party-average parameters (workers
-                    # push params/num_workers).  Apply it every round so
-                    # pulls see fresh aggregates — the reference calls
-                    # ApplyUpdates every round and skips only the WAN hop
-                    # (kvstore_dist_server.h:1326-1332)
-                    self._apply(key, merged)
-                    if (st.round + 1) % self.hfa_k2 == 0:
-                        # milestone sync: relay the normalized delta
-                        # (kvstore_dist_server.h:1334-1338).  The global
-                        # tier runs in accumulate mode and holds the real
-                        # model (init + every synced delta), so the pull
-                        # returns authoritative params — parties whose
-                        # milestones ever disagreed reconverge here,
-                        # unlike rebasing on the local milestone.
-                        # The WAN hop itself runs on the relay thread so
-                        # a straggler party's global barrier cannot stall
-                        # this server's other keys/pulls/heartbeats
-                        # (ADVICE r2 #3); the round completes on install.
-                        delta = (st.value.astype(np.float32) - st.milestone) \
-                            / self.num_global_workers
-                        self._relay_enqueue(key, (delta, True, False, None))
-                        return
-                else:
-                    self._relay_enqueue(key, (merged, False, False, None))
+                self._relay_enqueue(
+                    key, ((rows_u, vals_u), False, True, None))
+                return
+            self._apply_row_sparse(key, rows_u, vals_u)
+            self._finish_round_locked(key, st)
+            return
+        if self._gclients:
+            if self.hfa_k2 is not None:
+                # HFA: `merged` is the party-average parameters (workers
+                # push params/num_workers).  Apply it every round so
+                # pulls see fresh aggregates — the reference calls
+                # ApplyUpdates every round and skips only the WAN hop
+                # (kvstore_dist_server.h:1326-1332)
+                self._apply(key, merged)
+                if (st.round + 1) % self.hfa_k2 == 0:
+                    # milestone sync: relay the normalized delta
+                    # (kvstore_dist_server.h:1334-1338).  The global
+                    # tier runs in accumulate mode and holds the real
+                    # model (init + every synced delta), so the pull
+                    # returns authoritative params — parties whose
+                    # milestones ever disagreed reconverge here,
+                    # unlike rebasing on the local milestone.
+                    # The WAN hop itself runs on the relay thread so
+                    # a straggler party's global barrier cannot stall
+                    # this server's other keys/pulls/heartbeats
+                    # (ADVICE r2 #3); the round completes on install.
+                    delta = (st.value.astype(np.float32) - st.milestone) \
+                        / self.num_global_workers
+                    self._relay_enqueue(key, (delta, True, False, None))
                     return
             else:
-                self._apply(key, merged)
-            self._finish_round_locked(key, st)
+                self._relay_enqueue(key, (merged, False, False, None))
+                return
+        else:
+            self._apply(key, merged)
+        self._finish_round_locked(key, st)
+
+    def evict_worker(self, sender: int) -> int:
+        """Server-side worker eviction (resilience/): shrink the sync
+        gate by one so the surviving workers' rounds complete instead of
+        stalling forever on a dead worker's pushes.  Any gradient the
+        evicted worker already merged into the open round stands
+        (excising it would need per-sender un-merge the additive store
+        cannot express), but it no longer counts toward the gate — the
+        round still waits for EVERY survivor instead of closing one push
+        early.  Rounds the smaller gate now satisfies close immediately.
+        Repeated eviction of the same sender is rejected (two liveness
+        agents reacting to one death must not shrink the gate twice);
+        the caller owns id validity — a worker that died before its
+        first push is a legitimate eviction the server cannot vet.
+        Returns the new num_workers."""
+        with self._lock:
+            if self.num_workers <= 1:
+                raise ValueError(
+                    "cannot evict below one worker: stop the server "
+                    "instead (an empty party has no rounds to complete)")
+            if sender in self._evicted:
+                raise ValueError(
+                    f"worker {sender} already evicted: a second eviction "
+                    "would shrink the sync gate past the real survivor "
+                    "count")
+            self._evicted.add(sender)
+            self.num_workers -= 1
+            for key, st in list(self._store.items()):
+                pushed = st.pushed.pop(sender, 0)
+                if pushed > st.round and st.count > 0:
+                    # the evicted worker contributed to the OPEN round:
+                    # its merge stands, but uncounting it keeps the gate
+                    # waiting for all num_workers survivors
+                    st.count -= 1
+                if 0 < st.count and st.count >= self.num_workers:
+                    self._complete_merge_locked(key, st)
+        self.heartbeats.unregister(sender)
+        return self.num_workers
 
     def _finish_round_locked(self, key: str, st: _KeyState):
         """Complete a sync round: bump the round counter, answer the pulls

@@ -11,14 +11,21 @@ hooks around the optimizer step, executed per-device inside shard_map:
   aggregation; identity for HFA, whose workers update locally);
 - ``sync_params``     — parameter-space communication after the optimizer
   (HFA's K1/K2 averaging with milestones; stale-copy refresh for MixedSync).
+
+Degraded-mode membership (resilience/): algorithms that set
+``supports_degraded`` accept a static live-party mask via
+``bind_membership`` — the dc-tier aggregate becomes a renormalized mean
+over surviving parties, and the mask is part of the traced step
+(changing it is a recompile boundary).
 """
 
 from __future__ import annotations
 
 import abc
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
+import numpy as np
 
 
 class SyncAlgorithm(abc.ABC):
@@ -29,10 +36,74 @@ class SyncAlgorithm(abc.ABC):
     num_parties: int = 1
     workers_per_party: int = 1
 
+    # degraded-mode membership (resilience/): None = every party live.
+    # Set only via bind_membership; algorithms opt in with
+    # supports_degraded (the mask changes the dc-tier algebra, and an
+    # algorithm that ignored it would silently average in a dead party's
+    # stale shard).
+    live_parties: Optional[Tuple[bool, ...]] = None
+    supports_degraded: bool = False
+
     def bind_topology(self, topology) -> "SyncAlgorithm":
         self.num_parties = topology.num_parties
         self.workers_per_party = topology.workers_per_party
         return self
+
+    # ---- membership (degraded-mode WAN sync) -------------------------------
+
+    def bind_membership(self, mask) -> "SyncAlgorithm":
+        """Bind a live-party mask (a MembershipEpoch or any boolean
+        sequence).  Call after bind_topology; an all-live mask clears
+        degraded mode.  The mask is STATIC in the traced step — a dead
+        party's shard is excluded by multiplication to exact zeros
+        before the dc collective and the mean renormalizes over
+        survivors — so changing it is a recompile boundary
+        (``Trainer.apply_membership``)."""
+        from geomx_tpu.topology import normalize_live_mask
+        mask = normalize_live_mask(getattr(mask, "live_mask", mask),
+                                   self.num_parties)
+        if all(mask):
+            self.live_parties = None
+            return self
+        if not self.supports_degraded:
+            raise ValueError(
+                f"sync algorithm {self.name!r} does not support a "
+                "degraded membership mask: its aggregation algebra has "
+                "no renormalized-survivor form (FSA, MixedSync and "
+                "PipelinedSync do)")
+        self.live_parties = mask
+        return self
+
+    @property
+    def num_live(self) -> int:
+        """Parties contributing to the dc tier under the bound mask."""
+        if self.live_parties is None:
+            return self.num_parties
+        return sum(self.live_parties)
+
+    def party_weight(self):
+        """This party's 0/1 contribution weight under the bound mask, or
+        None when every party is live (no masking work to trace).  Valid
+        only inside shard_map (reads the dc axis index)."""
+        if self.live_parties is None:
+            return None
+        import jax.numpy as jnp
+        from jax import lax
+        from geomx_tpu.topology import DC_AXIS
+        m = jnp.asarray(np.asarray(self.live_parties, np.float32))
+        return m[lax.axis_index(DC_AXIS)]
+
+    def reset_comm_state(self, params: Any, state: Any,
+                         policy: str = "reset") -> Any:
+        """Apply the membership-change residual policy to (host-side,
+        unreplicated) sync state: ``"reset"`` re-initializes dc-tier
+        communication state (error-feedback residuals, pipeline
+        double-buffers), ``"carry"`` keeps it (docs/resilience.md
+        documents the trade-off).  Base: nothing to reset."""
+        if policy not in ("reset", "carry"):
+            raise ValueError(f"unknown residual policy {policy!r}: "
+                             "expected 'reset' or 'carry'")
+        return state
 
     def init_state(self, params: Any, model_state: Any = None) -> Any:
         """Algorithm state from example (unsharded, single-replica) params.

@@ -36,6 +36,7 @@ from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
 
 class FSA(SyncAlgorithm):
     name = "fsa"
+    supports_degraded = True  # renormalized survivor mean (resilience/)
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
                  worker_compressor: Optional[Compressor] = None,
@@ -66,10 +67,18 @@ class FSA(SyncAlgorithm):
             grads, state["worker_comp"], WORKER_AXIS, nw)
         if nw > 1:  # single-worker parties skip the dead x/1 divide
             g = jax.tree.map(lambda x: x / nw, g)
+        # degraded mode: a dead party's shard is excluded (multiplied to
+        # exact zeros before the collective) and the mean renormalizes
+        # over the num_live survivors — for live parties the aggregate
+        # is bit-identical to the mean over survivors alone
+        w = self.party_weight()
+        if w is not None:
+            g = jax.tree.map(lambda x: x * w, g)
         # cross-party tier (DCN): compressed mean over parties
         g, dstate = self.dc_compressor.allreduce(g, state["dc_comp"], DC_AXIS, np_)
-        if np_ > 1:
-            g = jax.tree.map(lambda x: x / np_, g)
+        nl = self.num_live
+        if nl > 1:
+            g = jax.tree.map(lambda x: x / nl, g)
         return g, {"dc_comp": dstate, "worker_comp": wstate}
 
     def sync_model_state(self, model_state: Any, state: Any,
@@ -78,5 +87,24 @@ class FSA(SyncAlgorithm):
         if self.workers_per_party > 1:
             model_state = lax.pmean(model_state, WORKER_AXIS)
         if self.num_parties > 1:
-            model_state = lax.pmean(model_state, DC_AXIS)
+            w = self.party_weight()
+            if w is None:
+                model_state = lax.pmean(model_state, DC_AXIS)
+            else:
+                # renormalized survivor mean, same algebra as the grads
+                nl = self.num_live
+                model_state = jax.tree.map(
+                    lambda x: lax.psum(x * w, DC_AXIS) / nl, model_state)
         return model_state, state
+
+    def reset_comm_state(self, params: Any, state: Any,
+                         policy: str = "reset") -> Any:
+        """Membership-change policy: "reset" re-initializes the dc-tier
+        compressor state (error-feedback residuals accumulated against
+        the old membership would replay a dead party's history into the
+        renormalized mean); the worker tier is untouched — intra-party
+        membership did not change."""
+        state = super().reset_comm_state(params, state, policy)
+        if policy == "carry":
+            return state
+        return dict(state, dc_comp=self.dc_compressor.init_state(params))

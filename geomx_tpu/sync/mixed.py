@@ -37,6 +37,7 @@ from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
 
 class MixedSync(SyncAlgorithm):
     name = "mixed"
+    supports_degraded = True  # renormalized survivor mean (resilience/)
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
                  pull_interval: int = 1, dcasgd_lambda: float = 0.0,
@@ -73,11 +74,17 @@ class MixedSync(SyncAlgorithm):
             grads = jax.tree.map(
                 lambda g, w, ws: g + lam * g * g * (w - ws),
                 grads, params, state["stale"])
+        # degraded mode (resilience/): exclude dead parties' shards and
+        # renormalize the mean over survivors — same algebra as FSA
+        w = self.party_weight()
+        if w is not None:
+            grads = jax.tree.map(lambda g: g * w, grads)
         np_ = self.num_parties
         grads, dstate = self.dc_compressor.allreduce(
             grads, state["dc_comp"], DC_AXIS, np_)
-        if np_ > 1:  # single-party configs skip the dead g/1 divide
-            grads = jax.tree.map(lambda g: g / np_, grads)
+        nl = self.num_live
+        if nl > 1:  # single-survivor configs skip the dead g/1 divide
+            grads = jax.tree.map(lambda g: g / nl, grads)
         state = dict(state, dc_comp=dstate)
         return grads, state
 
@@ -95,5 +102,21 @@ class MixedSync(SyncAlgorithm):
         if self.workers_per_party > 1:
             model_state = lax.pmean(model_state, WORKER_AXIS)
         if self.num_parties > 1:
-            model_state = lax.pmean(model_state, DC_AXIS)
+            w = self.party_weight()
+            if w is None:
+                model_state = lax.pmean(model_state, DC_AXIS)
+            else:
+                nl = self.num_live
+                model_state = jax.tree.map(
+                    lambda x: lax.psum(x * w, DC_AXIS) / nl, model_state)
         return model_state, state
+
+    def reset_comm_state(self, params: Any, state: Any,
+                         policy: str = "reset") -> Any:
+        """Same policy as FSA: "reset" re-initializes dc-tier compressor
+        state; the stale-pull copy always carries (it tracks the true
+        weights, which survive a membership change unchanged)."""
+        state = super().reset_comm_state(params, state, policy)
+        if policy == "carry":
+            return state
+        return dict(state, dc_comp=self.dc_compressor.init_state(params))

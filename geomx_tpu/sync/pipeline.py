@@ -214,6 +214,8 @@ class PipelinedSync(SyncAlgorithm):
     wrapping explicitly: ``PipelinedSync(FSA(...), dcasgd_lambda=0.04)``.
     """
 
+    supports_degraded = True  # delegates the masked mean to FSA/MixedSync
+
     def __init__(self, inner: SyncAlgorithm, depth: Optional[int] = None,
                  dcasgd_lambda: float = 0.0):
         from geomx_tpu.sync.fsa import FSA
@@ -256,6 +258,33 @@ class PipelinedSync(SyncAlgorithm):
         super().bind_topology(topology)
         self.inner.bind_topology(topology)
         return self
+
+    # -- membership (degraded-mode WAN sync, resilience/) --------------------
+    def bind_membership(self, mask) -> "PipelinedSync":
+        # the inner algorithm owns the masked renormalized mean; this
+        # wrapper only needs the mask for its own drain divisor
+        super().bind_membership(mask)
+        self.inner.bind_membership(mask)
+        return self
+
+    def reset_comm_state(self, params: Any, state: Any,
+                         policy: str = "reset") -> Any:
+        """Membership-change policy for the pipeline: "reset" discards
+        the in-flight aggregate (it was launched under the OLD
+        membership — its buckets include the dead party's shard, or lack
+        the re-admitted one's) along with the inner compressor's
+        residuals, costing one extra warmup bubble; "carry" keeps both
+        and accepts one step whose stale aggregate mixes memberships
+        (renormalized by the NEW survivor count).  The DCASGD
+        previous-weights copy and the model-state buffer always carry —
+        both track replicated values that survive the change."""
+        s = SyncAlgorithm.reset_comm_state(self, params, state, policy)
+        if policy == "carry":
+            return s
+        inner_state = dict(s["inner"],
+                           dc_comp=self.inner.dc_compressor.init_state(
+                               params))
+        return dict(s, inner=inner_state)
 
     # -- state ---------------------------------------------------------------
     def init_state(self, params: Any, model_state: Any = None) -> Any:
@@ -316,7 +345,15 @@ class PipelinedSync(SyncAlgorithm):
         # consumed in-step
         if self.workers_per_party > 1:
             model_state = lax.pmean(model_state, WORKER_AXIS)
-        launched = lax.pmean(model_state, DC_AXIS)
+        w = self.party_weight()
+        if w is None:
+            launched = lax.pmean(model_state, DC_AXIS)
+        else:
+            # degraded membership: the launched stat aggregate is the
+            # renormalized survivor mean, same algebra as the grads
+            nl = self.num_live
+            launched = jax.tree.map(
+                lambda x: lax.psum(x * w, DC_AXIS) / nl, model_state)
         return state["inflight_ms"], dict(state, inflight_ms=launched)
 
     # -- draining ------------------------------------------------------------
@@ -328,8 +365,9 @@ class PipelinedSync(SyncAlgorithm):
         run it without feeding a batch."""
         comp = self.inner.dc_compressor
         g, dc_state = comp.peek(params, state["inner"]["dc_comp"])
-        if self.num_parties > 1:
-            g = jax.tree.map(lambda x: x / self.num_parties, g)
+        nl = self.num_live  # degraded drain renormalizes over survivors
+        if nl > 1:
+            g = jax.tree.map(lambda x: x / nl, g)
         new_state = dict(state,
                          inner=dict(state["inner"], dc_comp=dc_state))
         if self.dcasgd_lambda > 0.0:

@@ -38,6 +38,21 @@ SP_AXIS = "sp"          # sequence-parallel axis (ICI, innermost)
 REPLICA_AXES = (DC_AXIS, WORKER_AXIS)
 
 
+def normalize_live_mask(mask, num_parties: int):
+    """Canonicalize a live-party mask (resilience subsystem): a length-
+    ``num_parties`` tuple of bools with at least one survivor.  Accepts
+    any boolean-coercible sequence (a MembershipEpoch's ``live_mask``, a
+    list of 0/1, a numpy array)."""
+    m = tuple(bool(x) for x in mask)
+    if len(m) != num_parties:
+        raise ValueError(f"live mask has {len(m)} entries for "
+                         f"{num_parties} parties")
+    if not any(m):
+        raise ValueError("a membership epoch needs at least one live "
+                         "party — an all-dead mesh has no survivor mean")
+    return m
+
+
 @dataclasses.dataclass(frozen=True)
 class HiPSTopology:
     """A two-tier hierarchical data-parallel topology.

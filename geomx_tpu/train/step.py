@@ -96,6 +96,16 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         from geomx_tpu.parallel.multigps import MultiGPSPlan
         from geomx_tpu.sync.fsa import FSA
         from geomx_tpu.sync.pipeline import PipelinedSync
+        if sync.live_parties is not None:
+            # fail loudly (same contract as the FSA check below): the
+            # ZeRO-1 path calls the dc compressor directly and its big
+            # leaves live as worker-axis shards — a masked renormalized
+            # mean over sharded leaves needs per-shard re-layout this PR
+            # does not implement
+            raise ValueError(
+                "GEOMX_MULTI_GPS does not compose with a degraded "
+                "membership mask (resilience/): disable multi_gps or "
+                "run with every party live")
         if isinstance(sync, PipelinedSync):
             # fail loudly (same contract as the FSA check below): the
             # ZeRO-1 update consumes the dc-tier shard in-step by
@@ -235,7 +245,23 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         # global mean over every worker for reporting
         if sp > 1:
             metrics = jax.lax.pmean(metrics, SP_AXIS)
-        metrics = jax.lax.pmean(jax.lax.pmean(metrics, WORKER_AXIS), DC_AXIS)
+        metrics = jax.lax.pmean(metrics, WORKER_AXIS)
+        pw = sync.party_weight()
+        if pw is None:
+            metrics = jax.lax.pmean(metrics, DC_AXIS)
+        else:
+            # degraded membership: report the mean over SURVIVORS — a
+            # dead party's loss/accuracy describes data that never
+            # reached the aggregate
+            metrics = jax.tree.map(
+                lambda x: jax.lax.psum(x * pw, DC_AXIS) / sync.num_live,
+                metrics)
+        # step metadata: the live-party count baked into this traced
+        # step (static — the membership epoch is a recompile boundary);
+        # bench.py --compare-resilience reads it back as evidence that
+        # degraded steps really ran the renormalized survivor mean
+        metrics["num_live_parties"] = jnp.asarray(sync.num_live,
+                                                  jnp.float32)
 
         new_state = TrainState(
             step=step + 1,

@@ -21,7 +21,8 @@ from geomx_tpu.data.loader import GeoDataLoader
 from geomx_tpu.sync import get_sync_algorithm
 from geomx_tpu.sync.base import SyncAlgorithm
 from geomx_tpu.topology import HiPSTopology
-from geomx_tpu.train.state import TrainState, replicate_tree
+from geomx_tpu.train.state import (TrainState, replicate_tree,
+                                   unreplicate_tree)
 from geomx_tpu.train.step import build_eval_step, build_train_step, make_loss_fn
 from geomx_tpu.utils.metrics import Measure
 
@@ -58,9 +59,19 @@ class Trainer:
                 "— correct but wasted chips. Use an sp-aware model (e.g. "
                 "SeqClassifier(sp_mode='ring')) or sp_degree=1.",
                 RuntimeWarning, stacklevel=2)
+        self._sp_model = sp_model
+        self._donate = donate
         self.train_step = build_train_step(
             self.loss_fn, self.tx, self.sync, topology, self.mesh,
             donate=donate, config=self.config, sp_model=sp_model)
+        # membership epochs (resilience/): the live-party mask currently
+        # bound into self.train_step; None = every party live.  Each
+        # distinct mask owns one compiled step program (the recompile
+        # boundary), cached so a blackout/re-admit cycle compiles twice,
+        # not per transition.
+        self._membership: Optional[tuple] = None
+        self._membership_version = 0
+        self._step_cache = {None: self.train_step}
         self._mgps = None
         if self.config.multi_gps:
             from geomx_tpu.parallel.multigps import MultiGPSPlan
@@ -141,6 +152,107 @@ class Trainer:
                              split_by_class=split_by_class, seed=seed,
                              sharding=sharding, augment=augment,
                              device_cache=device_cache)
+
+    # ---- membership epochs (resilience/) ----------------------------------
+
+    def apply_membership(self, state: TrainState, epoch,
+                         policy: Optional[str] = None) -> TrainState:
+        """Bind a new membership epoch (a ``MembershipEpoch`` or a
+        live-party mask) — the recompile boundary of the resilience
+        subsystem.
+
+        Rebinds the sync algorithm to the mask, swaps ``train_step`` to
+        the mask's compiled program (built on first use, cached after),
+        and applies the residual policy to ``state.sync_state``:
+        ``"reset"`` (default; ``GEOMX_RESILIENCE_RESIDUALS``) discards
+        dc-tier error-feedback residuals and pipeline in-flight buffers
+        accumulated under the old membership, ``"carry"`` keeps them
+        (docs/resilience.md).  Returns the adjusted state; a no-op when
+        the mask is unchanged.
+
+        Re-admission: call :meth:`catchup_payload` for the state blob
+        the returning party installs (``admit_party``) BEFORE this
+        rebind widens the collective back over it."""
+        from geomx_tpu.topology import normalize_live_mask
+        mask = normalize_live_mask(getattr(epoch, "live_mask", epoch),
+                                   self.topology.num_parties)
+        key = None if all(mask) else mask
+        if key == self._membership:
+            return state
+        if self._mgps is not None:
+            raise ValueError(
+                "GEOMX_MULTI_GPS does not compose with membership "
+                "changes (resilience/): the ZeRO-1 shards have no "
+                "renormalized-survivor form")
+        if policy is None:
+            # config-first, like every other knob: GeoConfig.from_env is
+            # where GEOMX_RESILIENCE_RESIDUALS folds in, so an explicit
+            # GeoConfig(resilience_residuals=...) must not be overridden
+            # by a stale env var
+            policy = getattr(self.config, "resilience_residuals",
+                             None) or "reset"
+        if policy not in ("reset", "carry"):
+            # validate BEFORE any rebinding: a bad policy must not leave
+            # the trainer half-switched to the new mask
+            raise ValueError(f"unknown residual policy {policy!r}: "
+                             "expected 'reset' or 'carry'")
+        self.sync.bind_membership(mask)
+        self._membership = key
+        self._membership_version = getattr(epoch, "version",
+                                           self._membership_version + 1)
+        step_fn = self._step_cache.get(key)
+        if step_fn is None:
+            step_fn = build_train_step(
+                self.loss_fn, self.tx, self.sync, self.topology,
+                self.mesh, donate=self._donate, config=self.config,
+                sp_model=self._sp_model)
+            self._step_cache[key] = step_fn
+        self.train_step = step_fn
+        # both close over the previous membership's traced program
+        self._epoch_runners.clear()
+        self._drain_step = None
+        # residual/buffer policy, applied host-side on copy (0, 0) and
+        # re-replicated (sync state is identical across replicas for
+        # every membership-capable algorithm)
+        new_ss = self.sync.reset_comm_state(
+            unreplicate_tree(state.params),
+            unreplicate_tree(state.sync_state), policy)
+        return TrainState(
+            step=state.step, params=state.params,
+            opt_state=state.opt_state, model_state=state.model_state,
+            sync_state=replicate_tree(new_ss, self.topology, self.mesh))
+
+    def catchup_payload(self, state: TrainState) -> bytes:
+        """The re-admission catch-up blob: one unreplicated copy of the
+        full TrainState (params, optimizer, model state AND sync state),
+        serialized in the checkpoint tree format — what the surviving
+        parties broadcast to a returning party before
+        ``apply_membership`` widens the collective back over it."""
+        from geomx_tpu.resilience.liveness import pack_catchup
+        return pack_catchup(TrainState(
+            step=np.asarray(jax.device_get(state.step)),
+            params=unreplicate_tree(state.params),
+            opt_state=unreplicate_tree(state.opt_state),
+            model_state=unreplicate_tree(state.model_state),
+            sync_state=unreplicate_tree(state.sync_state)))
+
+    def admit_party(self, payload: bytes) -> TrainState:
+        """Install a catch-up payload as this process's authoritative
+        state (the returning party's half of the protocol): the inverse
+        of :meth:`catchup_payload`, re-replicated with the same
+        placement ``init_state`` uses."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from geomx_tpu.resilience.liveness import unpack_catchup
+        t = unpack_catchup(payload)
+        return TrainState(
+            step=jax.device_put(jnp.asarray(t.step),
+                                NamedSharding(self.mesh, PartitionSpec())),
+            params=replicate_tree(t.params, self.topology, self.mesh),
+            opt_state=replicate_tree(t.opt_state, self.topology, self.mesh),
+            model_state=replicate_tree(t.model_state, self.topology,
+                                       self.mesh),
+            sync_state=replicate_tree(t.sync_state, self.topology,
+                                      self.mesh))
 
     def drain_pipeline(self, state: TrainState) -> TrainState:
         """Apply a pipelined sync algorithm's completed in-flight dc-tier

@@ -28,27 +28,17 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
-def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
-    """Save a pytree (e.g. TrainState). Returns the final path."""
-    if step is not None:
-        path = os.path.join(path, f"step_{step}")
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    host_state = _to_host(state)
-    final = path if path.endswith(".ckpt") else path + ".ckpt"
-    tmp = final + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(host_state, f)
-    os.replace(tmp, final)  # a crash mid-write never corrupts a checkpoint
-    return final
+def tree_to_bytes(tree: Any) -> bytes:
+    """Serialize a pytree of host/device arrays to bytes — the one wire
+    format checkpoints AND the resilience catch-up protocol share (a
+    re-admitted party installs exactly what a restored process would)."""
+    return pickle.dumps(_to_host(tree), protocol=4)
 
 
-def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
-    """Load a checkpoint; if `target` given, restores its pytree structure
-    and re-places leaves with the target's shardings."""
-    if not path.endswith(".ckpt"):
-        path = path + ".ckpt"
-    with open(path, "rb") as f:
-        host_state = pickle.load(f)
+def tree_from_bytes(blob: bytes, target: Optional[Any] = None) -> Any:
+    """Inverse of :func:`tree_to_bytes`; with ``target``, restores its
+    pytree structure and re-places leaves with the target's shardings."""
+    host_state = pickle.loads(blob)
     if target is None:
         return host_state
     flat_t, treedef = jax.tree.flatten(target)
@@ -62,3 +52,25 @@ def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
         else:
             placed.append(h)
     return treedef.unflatten(placed)
+
+
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
+    """Save a pytree (e.g. TrainState). Returns the final path."""
+    if step is not None:
+        path = os.path.join(path, f"step_{step}")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    final = path if path.endswith(".ckpt") else path + ".ckpt"
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(tree_to_bytes(state))
+    os.replace(tmp, final)  # a crash mid-write never corrupts a checkpoint
+    return final
+
+
+def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
+    """Load a checkpoint; if `target` given, restores its pytree structure
+    and re-places leaves with the target's shardings."""
+    if not path.endswith(".ckpt"):
+        path = path + ".ckpt"
+    with open(path, "rb") as f:
+        return tree_from_bytes(f.read(), target=target)

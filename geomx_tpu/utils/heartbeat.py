@@ -30,6 +30,13 @@ class HeartbeatMonitor:
         with self._lock:
             self._last[node_id] = time.monotonic()
 
+    def unregister(self, node_id: int):
+        """Forget a node entirely (server-side eviction, resilience/):
+        an evicted node must stop counting as dead — its absence is now
+        policy, not failure."""
+        with self._lock:
+            self._last.pop(node_id, None)
+
     def dead_nodes(self, timeout_s: Optional[float] = None) -> List[int]:
         """Nodes silent for longer than the timeout
         (reference GetDeadNodes(t))."""
@@ -37,6 +44,14 @@ class HeartbeatMonitor:
         now = time.monotonic()
         with self._lock:
             return sorted(n for n, ts in self._last.items() if now - ts > t)
+
+    def alive_nodes(self, timeout_s: Optional[float] = None) -> List[int]:
+        """Complement of dead_nodes over the registered set — what the
+        PartyLivenessController folds into a live-party mask."""
+        t = timeout_s if timeout_s is not None else self.timeout_s
+        now = time.monotonic()
+        with self._lock:
+            return sorted(n for n, ts in self._last.items() if now - ts <= t)
 
     @property
     def num_dead_nodes(self) -> int:

@@ -37,9 +37,32 @@ if _cc != "0":
     # embed the writer's machine-feature flags, and writers from
     # different environment profiles must not share entries (XLA warns
     # "+prefer-no-scatter ... SIGILL" on mismatched loads)
+    import hashlib
     import importlib.util
+    import platform
+
+    def _cpu_identity():
+        """Host machine identity for the profile key: CPU AOT executables
+        embed the writer's machine-feature flags, so a checkout shared
+        across heterogeneous hosts (NFS home, bind-mounted containers)
+        must not share entries either — arch plus a fingerprint of the
+        /proc/cpuinfo feature flags separates them."""
+        ident = platform.machine() or "unknown"
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.lower().startswith(("flags", "features")):
+                        flags = " ".join(sorted(
+                            line.split(":", 1)[1].split()))
+                        return (f"{ident}-"
+                                f"{hashlib.md5(flags.encode()).hexdigest()[:8]}")
+        except OSError:
+            pass  # non-Linux: arch alone still separates cross-arch shares
+        return ident
+
     _prof = (f"jax{jax.__version__}-"
-             f"{'plugin' if importlib.util.find_spec('jax_plugins') else 'plain'}")
+             f"{'plugin' if importlib.util.find_spec('jax_plugins') else 'plain'}-"
+             f"{_cpu_identity()}")
     from geomx_tpu.utils import enable_compile_cache
     enable_compile_cache(
         _cc or os.path.join(os.path.dirname(__file__),

@@ -147,3 +147,30 @@ def test_bench_resume_child_recovers_failed_unit(tmp_path):
             "configs", {}).get("bsc", {}))
         for ln in lines if ln.startswith("{"))
     assert saw_fault, "first child's config error never surfaced"
+
+
+def test_resume_clears_error_only_when_all_units_good():
+    """ADVICE r5 #4: a clean resume attempt must NOT reset the top-level
+    error while some recorded unit still carries a per-unit failure —
+    the headline would say success over a failing scorecard."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    good = {"samples_per_sec_per_chip": 1.0}
+    results = {"configs": {"a": dict(good), "b": {"error": "boom"}},
+               "backend": {}, "fit_loop": None, "microbench": None,
+               "profile": None, "batch_sweep": None, "tta": None,
+               "tta_s2d": None}
+    # clean resume, but config "b" still failed -> keep the error
+    assert not bench._resume_clears_error(results, True, None)
+    # the failed unit recovers -> now the error may clear
+    results["configs"]["b"] = dict(good)
+    assert bench._resume_clears_error(results, True, None)
+    # a resume that itself failed never clears, even with good units
+    assert not bench._resume_clears_error(results, True, "watchdog")
+    assert not bench._resume_clears_error(results, False, None)
+    # a failed resumable phase (e.g. tta) also blocks the clear
+    results["tta"] = {"error": "died"}
+    assert not bench._resume_clears_error(results, True, None)

@@ -337,3 +337,48 @@ def test_fused_ulysses_gradients_match_jnp():
     for a, b in zip(gf, gj):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_fused_auto_gate_mirrors_ring_block_alignment():
+    """The fused auto-gate must fall back to the streaming path when the
+    flash kernel's padded seq block is not 8-aligned (ADVICE r5 #2):
+    the kernel tiles the full post-all_to_all sequence in blocks of
+    min(128, L), and Mosaic rejects non-sublane-aligned blocks — the
+    same gate ring_attention applies to its hop block."""
+    from geomx_tpu.parallel.ulysses import _fused_block_aligned
+
+    # L >= 128 tiles at the 128 block: always aligned
+    assert _fused_block_aligned(128)
+    assert _fused_block_aligned(4096)
+    assert _fused_block_aligned(129)  # block stays 128; L pads up
+    # short sequences: the block IS the (padded) length
+    assert _fused_block_aligned(64)
+    assert _fused_block_aligned(8)
+    assert not _fused_block_aligned(20)   # pads to 20, 20 % 8 != 0
+    assert not _fused_block_aligned(100)  # 100 % 8 != 0
+    assert not _fused_block_aligned(6)
+
+
+def test_ulysses_misaligned_short_seq_runs_streaming_fallback():
+    """End-to-end: a sequence whose padded block is not 8-aligned (per-
+    shard 5 tokens x 4 shards = L 20) must run (auto-gate falls back to
+    the jnp streaming path) and match the dense reference."""
+    from geomx_tpu.parallel.ulysses import ulysses_attention
+
+    rng = np.random.RandomState(12)
+    B, L, H, D = 2, 20, 4, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, L, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    devs = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    spec = P(None, "sp", None, None)
+
+    def f(ql, kl, vl):
+        return ulysses_attention(ql, kl, vl, "sp", causal=True)
+
+    fn = shard_map_compat(f, mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
+    out = jax.jit(fn)(q, k, v)
+    ref = full_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
