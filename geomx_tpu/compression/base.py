@@ -115,7 +115,7 @@ _SPEC_GRAMMAR = {
     "2bit": (["threshold"], {"threshold": float}),
     "bsc": (["ratio"], {"ratio": float, "select": str,
                         "min_sparse_size": _parse_int,
-                        "approx": _parse_bool}),
+                        "approx": _parse_bool, "fused": _parse_bool}),
     "mpq": (["ratio", "size_lower_bound"],
             {"ratio": float, "size_lower_bound": _parse_int,
              "bf16": _parse_bool, "approx": _parse_bool}),

@@ -49,17 +49,29 @@ class BiSparseCompressor(Compressor):
 
     def __init__(self, ratio: float = 0.01, approx: "bool | None" = None,
                  min_sparse_size: int = 1024,
-                 select: "str | None" = None):
+                 select: "str | None" = None,
+                 fused: "bool | None" = None,
+                 fused_interpret: bool = False):
         """``select``: "exact" (lax.top_k), "approx" (lax.approx_max_k),
         or "sampled" (the reference's sampled-boundary scan,
-        ops/sampled_topk.py).  Default: GEOMX_BSC_SELECT if set, else
-        "approx" on TPU and "exact" elsewhere (deterministic behavioral
-        tests vs the reference recurrences run on CPU).  ``approx`` is
-        the legacy boolean spelling of exact/approx."""
+        ops/sampled_topk.py).  Default: GEOMX_BSC_SELECT if set, else —
+        on a TPU with the fused kernels enabled — "sampled" (the fused
+        ops/bsc_pallas.py path IS the sampled scan, now one VMEM-resident
+        pass), else "approx" on TPU and "exact" elsewhere (deterministic
+        behavioral tests vs the reference recurrences run on CPU).
+        ``approx`` is the legacy boolean spelling of exact/approx.
+
+        ``fused``: use the Pallas kernels (ops/bsc_pallas.py) — the
+        select/pack kernel when ``select == "sampled"`` (the other
+        selections keep their lax.top_k forms) and the scatter-add
+        decompress for every selection.  Default: on when the backend is
+        TPU and GEOMX_FUSED_KERNELS != 0.  ``fused_interpret`` runs the
+        kernels in Pallas interpret mode (CPU parity tests)."""
         import os
         if ratio <= 0:
             raise ValueError("threshold must be greater than 0")
         self.ratio = float(ratio)
+        from geomx_tpu.ops.bsc_pallas import fused_kernels_enabled
         if select is None:
             if approx is not None:
                 select = "approx" if approx else "exact"
@@ -68,13 +80,23 @@ class BiSparseCompressor(Compressor):
                 # falls back to the platform default
                 select = os.environ.get("GEOMX_BSC_SELECT") or None
             if select is None:
-                from geomx_tpu.compression.base import default_on_tpu
-                select = "approx" if default_on_tpu(
-                    "GEOMX_BSC_APPROX_TOPK") else "exact"
+                if fused or (fused is None and fused_kernels_enabled()):
+                    select = "sampled"
+                else:
+                    from geomx_tpu.compression.base import default_on_tpu
+                    select = "approx" if default_on_tpu(
+                        "GEOMX_BSC_APPROX_TOPK") else "exact"
         if select not in ("exact", "approx", "sampled"):
             raise ValueError(f"unknown BSC selection {select!r}")
         self.select = select
         self.approx = select == "approx"
+        if fused is None:
+            fused = fused_kernels_enabled()
+        self.fused = bool(fused)
+        # the fused select kernel implements the sampled scan only; the
+        # fused decompress applies to every selection mode
+        self.fused_select = self.fused and select == "sampled"
+        self.fused_interpret = bool(fused_interpret)
         # tensors smaller than this aren't worth sparsifying: 2*k payload
         # would approach the dense size; send dense fp32 instead
         self.min_sparse_size = int(min_sparse_size)
@@ -99,6 +121,18 @@ class BiSparseCompressor(Compressor):
         """
         n = g_flat.shape[0]
         k = self.k_for(n)
+        if self.fused_select:
+            # one VMEM-resident pass: momentum math, boundary select,
+            # fixed-k pack and EF reset fused (ops/bsc_pallas.py); only
+            # the ~8k-element threshold probe runs in XLA
+            from geomx_tpu.ops.bsc_pallas import (bsc_select_pack,
+                                                  sampled_boundary_guv)
+            from geomx_tpu.utils.profiler import profile_scope
+            thr = sampled_boundary_guv(g_flat, u, v, k)
+            with profile_scope("bsc/select_pack", category="kernel",
+                              args={"n": n, "k": k}):
+                return bsc_select_pack(g_flat, u, v, thr, k,
+                                       interpret=self.fused_interpret)
         u = u * MOMENTUM + g_flat
         v = v + u
         absv = jnp.abs(v)
@@ -125,6 +159,15 @@ class BiSparseCompressor(Compressor):
         """Scatter-add (value, index) pairs into a dense vector
         (reference BSCDecompress, gc.cc:310-336). Negative indices are
         padding sentinels and are dropped."""
+        if self.fused:
+            # fused scatter-add: no XLA scatter, no per-party dense
+            # intermediate (ops/bsc_pallas.py)
+            from geomx_tpu.ops.bsc_pallas import bsc_scatter_add
+            from geomx_tpu.utils.profiler import profile_scope
+            with profile_scope("bsc/scatter_add", category="kernel",
+                              args={"n": n, "pairs": int(vals.shape[0])}):
+                return bsc_scatter_add(vals, idx, n,
+                                       interpret=self.fused_interpret)
         valid = idx >= 0
         safe_idx = jnp.where(valid, idx, 0)
         contrib = jnp.where(valid, vals, 0.0)

@@ -87,9 +87,16 @@ class GradientBucketer:
 
     def __init__(self, leaves: Sequence[Any],
                  bucket_bytes: int = DEFAULT_BUCKET_BYTES,
-                 pad_to: int = _LANE_PAD):
+                 pad_to: int = _LANE_PAD,
+                 fused: "Optional[bool]" = None,
+                 fused_interpret: bool = False):
         if bucket_bytes <= 0:
             raise ValueError(f"bucket_bytes must be > 0, got {bucket_bytes}")
+        if fused is None:
+            from geomx_tpu.ops.bsc_pallas import fused_kernels_enabled
+            fused = fused_kernels_enabled()
+        self.fused = bool(fused)
+        self.fused_interpret = bool(fused_interpret)
         self.pad_to = max(1, int(pad_to))
         self.capacity = max(self.pad_to, int(bucket_bytes) // 4)
         self.leaf_shapes = [tuple(l.shape) for l in leaves]
@@ -115,8 +122,25 @@ class GradientBucketer:
     def num_buckets(self) -> int:
         return len(self.bucket_sizes)
 
+    def _layout(self) -> tuple:
+        """leaf -> (bucket, offset, size) triples (static, hashable) for
+        the fused DMA kernels."""
+        return tuple((b, off, size) for (b, off), size in
+                     zip(self.assignments, self.leaf_sizes))
+
     def flatten(self, leaves: Sequence[jax.Array]) -> List[jax.Array]:
-        """Pytree leaves -> list of flat fp32 buckets (padded)."""
+        """Pytree leaves -> list of flat fp32 buckets (padded).
+
+        With the fused kernels enabled, one Pallas DMA kernel gathers
+        every leaf into its bucket slot (ops/bucket_pallas.py) instead
+        of one XLA concatenate operand per leaf; the jnp path below is
+        the bit-identical fallback and parity oracle."""
+        if self.fused and self.num_buckets > 0:
+            from geomx_tpu.ops.bucket_pallas import fused_flatten
+            flat = [l.reshape(-1).astype(jnp.float32) for l in leaves]
+            return fused_flatten(flat, self._layout(),
+                                 tuple(self.bucket_sizes),
+                                 interpret=self.fused_interpret)
         pieces: List[List[jax.Array]] = [[] for _ in range(self.num_buckets)]
         for leaf, (b, _off) in zip(leaves, self.assignments):
             pieces[b].append(leaf.reshape(-1).astype(jnp.float32))
@@ -130,6 +154,14 @@ class GradientBucketer:
 
     def unflatten(self, buckets: Sequence[jax.Array]) -> List[jax.Array]:
         """Flat buckets -> leaves with their original shapes and dtypes."""
+        if self.fused and self.num_buckets > 0:
+            from geomx_tpu.ops.bucket_pallas import fused_unflatten
+            flat = fused_unflatten([b.reshape(-1) for b in buckets],
+                                   self._layout(), tuple(self.leaf_sizes),
+                                   interpret=self.fused_interpret)
+            return [f.reshape(shape).astype(dtype)
+                    for f, shape, dtype in zip(flat, self.leaf_shapes,
+                                               self.leaf_dtypes)]
         out = []
         for (b, off), shape, dtype, size in zip(
                 self.assignments, self.leaf_shapes, self.leaf_dtypes,
@@ -163,7 +195,9 @@ class BucketedCompressor(Compressor):
 
     def __init__(self, inner: Compressor,
                  bucket_bytes: Optional[int] = None,
-                 pad_to: int = _LANE_PAD):
+                 pad_to: int = _LANE_PAD,
+                 fused: Optional[bool] = None,
+                 fused_interpret: bool = False):
         self.inner = inner
         self.name = inner.name
         self.bucket_bytes = _resolve_bucket_bytes(bucket_bytes)
@@ -172,6 +206,8 @@ class BucketedCompressor(Compressor):
                              "use the bare inner compressor to disable "
                              "bucketing")
         self.pad_to = pad_to
+        self.fused = fused
+        self.fused_interpret = fused_interpret
         self._bucketers: dict = {}
 
     # -- layout cache (one per tree structure, resolved at trace time) ------
@@ -179,7 +215,9 @@ class BucketedCompressor(Compressor):
         key = tuple((tuple(l.shape), jnp.dtype(l.dtype).str) for l in leaves)
         bk = self._bucketers.get(key)
         if bk is None:
-            bk = GradientBucketer(leaves, self.bucket_bytes, self.pad_to)
+            bk = GradientBucketer(leaves, self.bucket_bytes, self.pad_to,
+                                  fused=self.fused,
+                                  fused_interpret=self.fused_interpret)
             self._bucketers[key] = bk
         return bk
 

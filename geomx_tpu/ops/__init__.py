@@ -8,12 +8,20 @@ makes one HBM round trip:
 - ``quantize_2bit``: residual += grad; threshold compare; pack 16 2-bit
   codes per int32 word; residual -= sent — one pass.
 - ``dequantize_2bit``: unpack + scale.
+- ``bsc_select_pack`` / ``bsc_scatter_add``: the Bi-Sparse dc-tier hot
+  path — momentum correction, sampled-boundary select, fixed-k
+  (value, index) pack and error-feedback reset fused into one pass,
+  plus the dense scatter-add reconstruction (docs/kernels.md).
+- ``fused_flatten`` / ``fused_unflatten``: the bucket (un)flatten as a
+  single DMA kernel instead of one XLA copy per pytree leaf.
 - ``flash_attention`` / ``fused_attention``: online-softmax attention
   for the long-context path — the [L, L] score matrix never reaches
   HBM (the reference has no attention operator at all).
 
 Kernels run natively on TPU and in Pallas interpret mode elsewhere
 (tests exercise them on CPU via interpret mode).
+``GEOMX_FUSED_KERNELS=0`` is the master opt-out for the fused
+compression kernels (``fused_kernels_enabled``).
 """
 
 from geomx_tpu.ops.flash_attention import (flash_attention,
@@ -23,8 +31,13 @@ from geomx_tpu.ops.flash_attention import (flash_attention,
                                            fused_attention_supported)
 from geomx_tpu.ops.twobit_pallas import (quantize_2bit, dequantize_2bit,
                                          pallas_supported)
+from geomx_tpu.ops.bsc_pallas import (bsc_select_pack, bsc_scatter_add,
+                                      fused_kernels_enabled)
+from geomx_tpu.ops.bucket_pallas import fused_flatten, fused_unflatten
 
 __all__ = ["quantize_2bit", "dequantize_2bit", "pallas_supported",
+           "bsc_select_pack", "bsc_scatter_add", "fused_kernels_enabled",
+           "fused_flatten", "fused_unflatten",
            "flash_attention", "flash_attention_bwd",
            "flash_attention_with_lse", "fused_attention",
            "fused_attention_supported"]

@@ -1,0 +1,360 @@
+"""Fused Bi-Sparse (BSC) compression Pallas kernels.
+
+Two kernels replace the dc-tier sparse hot path that BENCH_CAPTURED_r05
+showed inverting the compression win on chip (bsc 14.10 ms/step vs
+vanilla 13.64 ms despite 32x fewer wire bytes):
+
+``bsc_select_pack``
+    One fused pass over the gradient bucket that computes the DGC-style
+    momentum correction ``u' = 0.9*u + g; v' = v + u'``, applies the
+    sampled magnitude boundary, emits the fixed-``k`` (value, index)
+    wire pairs, and zeroes the error-feedback buffers at the emitted
+    coordinates — everything the unfused XLA graph spreads over a
+    mask+cumsum+scatter chain of ~6 HBM-materialized intermediates
+    (``ops/sampled_topk.py``).  Bit-exact with that jnp reference:
+    identical values, indices (including the -1 sentinel padding and the
+    first-k-in-index-order tie rule), and residuals.
+
+``bsc_scatter_add``
+    The decompress: accumulates all parties' gathered (value, index)
+    pairs into the dense bucket without materializing a per-party dense
+    intermediate or an XLA scatter.  Exploits that the wire format is
+    two ascending index runs per party (see below), so each pair chunk
+    touches ~1 output block and the rest are skipped.
+
+Algorithm notes (select/pack).  The reference scan's two-tier rule
+(strictly-above-boundary elements claim slots first, boundary ties queue
+after *all* primaries — ``sampled_threshold_select``) needs the total
+primary count before any tie's slot is known, so the kernel runs a
+2-pass sequential grid over [8, 128] fp32 blocks: pass 0 emits the
+primary runs while accumulating the primary count in SMEM, pass 1 emits
+the tie runs offset by that total.  Within a block, element ranks come
+from matmul prefix-sums (lane-triangular [128,128] + row-triangular
+[8,8] — Mosaic has no native cumsum) and the kept elements compact into
+a contiguous run via a one-hot [1024,128] matmul per row; the run lands
+in the output at its dynamic global offset via an async copy.  Because
+every block's emitted ranks are consecutive, runs tile the output
+exactly; slots no run covers keep the sentinel fill they were
+initialized with (``input_output_aliases``).
+
+Wire-format stability: the fused kernel and the jnp reference emit
+byte-identical payloads (primaries in ascending index order, then ties,
+then -1/0.0 sentinel padding), so parties may mix fused and unfused
+paths in one job and checkpointed error-feedback state is
+interchangeable between them.
+
+VMEM budget per grid step: 3 input + 2 output [8,128] fp32 blocks
+(~20 KB), the [1024,128] one-hot (512 KB, transient), two [1024,1] run
+staging buffers (~1 MB physical after lane padding), and the [kpad,1]
+outputs live in HBM — comfortably inside the 16 MB scoped-vmem limit
+for any bucket size.
+
+Index arithmetic is int32 throughout: buckets are limited to 2**31-1
+elements (the bucketing default is 1 Mi elements per bucket).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+MOMENTUM = 0.9  # gc.cc:200 — must match compression/bisparse.py
+
+_LANES = 128
+_BLK_ROWS = 8                      # one fp32 tile of rows per grid step
+_BLK = _BLK_ROWS * _LANES          # 1024 elements per grid step
+_CHUNK = 512                       # (value, index) pairs per decompress step
+_OUT_ROWS = 128                    # dense output rows per decompress block
+
+
+def fused_kernels_enabled() -> bool:
+    """Master gate for the fused compression kernels: on when the default
+    backend is a TPU unless ``GEOMX_FUSED_KERNELS=0`` opts out (the
+    shared TPU-fast-path policy, compression/base.default_on_tpu).  The
+    jnp reference paths stay bit-exact on every backend and serve as the
+    parity oracle (tests/test_bsc_pallas.py)."""
+    from geomx_tpu.compression.base import default_on_tpu
+    return default_on_tpu("GEOMX_FUSED_KERNELS")
+
+
+def sampled_boundary_guv(g: jax.Array, u: jax.Array, v: jax.Array, k: int,
+                         sample: int = 8192):
+    """The sampled magnitude boundary computed WITHOUT materializing the
+    dense momentum-corrected tensor: gathers the ~``sample`` probe
+    positions of g/u/v and applies the momentum arithmetic to just those
+    — the full ``|v + (0.9u + g)|`` lives only inside the fused kernel.
+    Same quantile rule as ``ops.sampled_topk.sampled_boundary``."""
+    from geomx_tpu.ops.sampled_topk import sample_positions
+
+    n = g.shape[0]
+    pos = jnp.asarray(sample_positions(n, sample), jnp.int32)
+    samp = jnp.abs(v[pos] + (u[pos] * MOMENTUM + g[pos]))
+    m = samp.shape[0]
+    ssorted = jnp.sort(samp)
+    p = int(round(m * (1.0 - int(k) / n)))
+    return ssorted[min(max(p, 0), m - 1)]
+
+
+def _ex_cumsum_flat(mask):
+    """Exclusive prefix count of ``mask`` [8, 128] in row-major (flat
+    index) order, as int32.  Mosaic lowers no cumsum primitive; the
+    standard TPU spelling is a pair of triangular matmuls (lane-level
+    [128,128], then row offsets via a strictly-lower [8,8])."""
+    m = mask.astype(jnp.float32)
+    lane_lt = (jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+               < jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+               ).astype(jnp.float32)
+    ex_lane = jax.lax.dot_general(m, lane_lt, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    rowtot = jnp.sum(m, axis=1, keepdims=True)                     # [8, 1]
+    row_gt = (jax.lax.broadcasted_iota(jnp.int32, (_BLK_ROWS, _BLK_ROWS), 1)
+              < jax.lax.broadcasted_iota(jnp.int32, (_BLK_ROWS, _BLK_ROWS), 0)
+              ).astype(jnp.float32)
+    ex_row = jax.lax.dot_general(row_gt, rowtot, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    return (ex_lane + ex_row).astype(jnp.int32)
+
+
+def _select_kernel(k, n, g_ref, u_ref, v_ref, thr_ref, vals_seed, idx_seed,
+                   newu_ref, newv_ref, vals_ref, idx_ref,
+                   cnt, run_val, run_idx, sems):
+    """Grid (2, nblocks): pass 0 emits primary (> thr) runs, pass 1 emits
+    tie (== thr) runs and the final error-feedback zeroing.  SMEM ``cnt``:
+    [0] = running primary count (pass 0; frozen total during pass 1),
+    [1] = pass-1 primary re-count, [2] = running tie count."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    del vals_seed, idx_seed  # aliased into vals_ref/idx_ref (sentinel fill)
+
+    pas = pl.program_id(0)
+    blk = pl.program_id(1)
+    thr = thr_ref[0, 0]
+    u2 = u_ref[:] * MOMENTUM + g_ref[:]
+    v2 = v_ref[:] + u2
+    absv = jnp.abs(v2)
+    base = blk * _BLK
+    flat = base + (
+        jax.lax.broadcasted_iota(jnp.int32, (_BLK_ROWS, _LANES), 0) * _LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (_BLK_ROWS, _LANES), 1))
+    valid = flat < n  # zero padding must not claim tie slots when thr == 0
+    primary = (absv > thr) & valid
+    secondary = (absv == thr) & valid
+    p_rank = _ex_cumsum_flat(primary)
+    s_rank = _ex_cumsum_flat(secondary)
+    # counts reduce in f32 (exact up to the 1024-element block; Mosaic
+    # implements no integer reductions)
+    p_cnt = jnp.sum(primary.astype(jnp.float32)).astype(jnp.int32)
+    s_cnt = jnp.sum(secondary.astype(jnp.float32)).astype(jnp.int32)
+
+    def emit(emit_mask, rank_local, start):
+        """Compact the block's emitted class (local ranks are consecutive
+        from 0) into a (value, index) run and copy it to output slots
+        [start, start+_BLK).  Slots past the run's true length carry the
+        sentinel pair (0.0, -1); the next block's run overwrites exactly
+        the non-sentinel prefix it owns, so the final tail stays
+        sentinel without a separate fill pass."""
+        erank = jnp.where(emit_mask, rank_local, -1)
+        slot = jax.lax.broadcasted_iota(jnp.int32, (_BLK, _LANES), 0)
+        accv = jnp.zeros((_BLK, 1), jnp.float32)
+        acci = jnp.zeros((_BLK, 1), jnp.float32)
+        for r in range(_BLK_ROWS):
+            onehot = (slot == erank[r:r + 1, :]).astype(jnp.float32)
+            accv = accv + jax.lax.dot_general(
+                onehot, v2[r:r + 1, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            # local flat index payload, +1 so "no hit" (0) maps to -1
+            loc = (jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+                   + (r * _LANES + 1)).astype(jnp.float32)
+            acci = acci + jax.lax.dot_general(
+                onehot, loc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        run_val[:] = accv
+        ai = acci.astype(jnp.int32)
+        run_idx[:] = jnp.where(ai > 0, base + ai - 1, -1)
+        off = jnp.minimum(start, k)  # blocks past k park on the pad region
+        cv = pltpu.make_async_copy(
+            run_val, vals_ref.at[pl.ds(off, _BLK), :], sems.at[0])
+        ci = pltpu.make_async_copy(
+            run_idx, idx_ref.at[pl.ds(off, _BLK), :], sems.at[1])
+        cv.start()
+        ci.start()
+        cv.wait()
+        ci.wait()
+
+    @pl.when((pas == 0) & (blk == 0))
+    def _():
+        cnt[0] = 0
+
+    @pl.when(pas == 0)
+    def _():
+        p_pre = cnt[0]
+        keep_p = primary & (p_pre + p_rank < k)
+        # interim EF state (pass 1 rewrites it with the tie zeroing too)
+        newu_ref[:] = jnp.where(keep_p, 0.0, u2)
+        newv_ref[:] = jnp.where(keep_p, 0.0, v2)
+        emit(keep_p, p_rank, p_pre)
+        cnt[0] = p_pre + p_cnt
+
+    @pl.when((pas == 1) & (blk == 0))
+    def _():
+        cnt[1] = 0
+        cnt[2] = 0
+
+    @pl.when(pas == 1)
+    def _():
+        np_tot = cnt[0]  # total primaries: ties queue after ALL of them
+        p_pre = cnt[1]
+        s_pre = cnt[2]
+        keep_p = primary & (p_pre + p_rank < k)
+        keep_s = secondary & (np_tot + s_pre + s_rank < k)
+        keep = keep_p | keep_s
+        newu_ref[:] = jnp.where(keep, 0.0, u2)
+        newv_ref[:] = jnp.where(keep, 0.0, v2)
+        emit(keep_s, s_rank, np_tot + s_pre)
+        cnt[1] = p_pre + p_cnt
+        cnt[2] = s_pre + s_cnt
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def bsc_select_pack(g: jax.Array, u: jax.Array, v: jax.Array,
+                    threshold: jax.Array, k: int, interpret: bool = False):
+    """Fused momentum + sampled-boundary select + fixed-k pack + EF reset.
+
+    Args: flat fp32 ``g``/``u``/``v`` of equal length ``n``; ``threshold``
+    a traced scalar (the sampled magnitude boundary); static ``k``.
+    Returns ``(vals[k], idx[k] int32 with -1 sentinels, new_u[n],
+    new_v[n])`` — bit-identical to the ``sampled_threshold_select`` +
+    error-feedback jnp chain in compression/bisparse.py.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = g.shape[0]
+    k = int(k)
+    rows = max(1, -(-n // _LANES))
+    rowsp = -(-rows // _BLK_ROWS) * _BLK_ROWS
+    pad = rowsp * _LANES - n
+
+    def shape2(x):
+        x = x.reshape(-1).astype(jnp.float32)
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+        return x.reshape(rowsp, _LANES)
+
+    kpad = k + _BLK
+    blk_spec = pl.BlockSpec((_BLK_ROWS, _LANES), lambda p, b: (b, 0))
+    newu, newv, vals, idx = pl.pallas_call(
+        functools.partial(_select_kernel, k, n),
+        grid=(2, rowsp // _BLK_ROWS),
+        in_specs=[
+            blk_spec, blk_spec, blk_spec,                       # g, u, v
+            pl.BlockSpec((1, 1), lambda p, b: (0, 0),
+                         memory_space=pltpu.SMEM),              # threshold
+            pl.BlockSpec(memory_space=pltpu.ANY),               # vals seed
+            pl.BlockSpec(memory_space=pltpu.ANY),               # idx seed
+        ],
+        out_specs=(
+            blk_spec, blk_spec,                                 # new u, v
+            pl.BlockSpec(memory_space=pltpu.ANY),               # vals
+            pl.BlockSpec(memory_space=pltpu.ANY),               # idx
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((rowsp, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rowsp, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((kpad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((kpad, 1), jnp.int32),
+        ),
+        scratch_shapes=[
+            pltpu.SMEM((4,), jnp.int32),
+            pltpu.VMEM((_BLK, 1), jnp.float32),
+            pltpu.VMEM((_BLK, 1), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={4: 2, 5: 3},
+        interpret=interpret,
+    )(shape2(g), shape2(u), shape2(v),
+      jnp.asarray(threshold, jnp.float32).reshape(1, 1),
+      jnp.zeros((kpad, 1), jnp.float32),
+      jnp.full((kpad, 1), -1, jnp.int32))
+    return (vals.reshape(-1)[:k], idx.reshape(-1)[:k],
+            newu.reshape(-1)[:n], newv.reshape(-1)[:n])
+
+
+def _scatter_kernel(out_rows, vals_ref, idx_ref, out_ref):
+    """Grid (out_blocks, pair_chunks), chunks innermost so the output
+    block stays VMEM-resident while every chunk streams past it.  The
+    scatter-add is two one-hot compares and one MXU matmul:
+    ``out[r, l] += sum_p (row_p == r) * v_p * (col_p == l)`` — exact
+    scatter-add semantics, no XLA scatter, no per-party dense buffer.
+    Because each party's index run is ascending, a chunk spans a narrow
+    index range and the min/max guard skips every other block (the
+    sentinel pairs, idx -1, never match any block)."""
+    import jax.experimental.pallas as pl
+
+    blk = pl.program_id(0)
+    chunk = pl.program_id(1)
+
+    @pl.when(chunk == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ix = idx_ref[:]                                             # [S, 1]
+    lo = blk * out_rows * _LANES
+    hi = lo + out_rows * _LANES
+    # range guard reduces in f32 (Mosaic implements no integer
+    # reductions); f32 rounds large indices by up to 0.5 ULP, so widen
+    # the window by 256 (covers int32 range) — a false inclusion only
+    # costs one skippable matmul, never correctness
+    ixf = ix.astype(jnp.float32)
+    cmax = jnp.max(ixf)
+    cmin = jnp.min(jnp.where(ix >= 0, ixf, jnp.float32(2. ** 31)))
+
+    @pl.when((cmax >= lo - 256) & (cmin < hi + 256))
+    def _():
+        valid = ix >= 0
+        row = jnp.where(valid, ix // _LANES - blk * out_rows, -1)
+        col = jnp.where(valid, ix % _LANES, -1)
+        a = (row == jax.lax.broadcasted_iota(
+            jnp.int32, (_CHUNK, out_rows), 1)).astype(jnp.float32)
+        a = a * vals_ref[:]
+        b = (col == jax.lax.broadcasted_iota(
+            jnp.int32, (_CHUNK, _LANES), 1)).astype(jnp.float32)
+        out_ref[:] += jax.lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def bsc_scatter_add(vals: jax.Array, idx: jax.Array, n: int,
+                    interpret: bool = False) -> jax.Array:
+    """Fused dense reconstruction: scatter-add (value, index) pairs into
+    a flat fp32 vector of length ``n``.  Negative indices are sentinel
+    padding and contribute nothing; colliding indices accumulate (the
+    all-parties aggregate of compression/bisparse.py's decompress)."""
+    import jax.experimental.pallas as pl
+
+    m = vals.shape[0]
+    mp = max(_CHUNK, -(-m // _CHUNK) * _CHUNK)
+    if mp != m:
+        vals = jnp.concatenate(
+            [vals.astype(jnp.float32), jnp.zeros((mp - m,), jnp.float32)])
+        idx = jnp.concatenate(
+            [idx.astype(jnp.int32), jnp.full((mp - m,), -1, jnp.int32)])
+    rows = max(1, -(-n // _LANES))
+    out_rows = min(_OUT_ROWS, -(-rows // _BLK_ROWS) * _BLK_ROWS)
+    rowsp = -(-rows // out_rows) * out_rows
+    out = pl.pallas_call(
+        functools.partial(_scatter_kernel, out_rows),
+        grid=(rowsp // out_rows, mp // _CHUNK),
+        in_specs=[
+            pl.BlockSpec((_CHUNK, 1), lambda b, c: (c, 0)),
+            pl.BlockSpec((_CHUNK, 1), lambda b, c: (c, 0)),
+        ],
+        out_specs=pl.BlockSpec((out_rows, _LANES), lambda b, c: (b, 0)),
+        out_shape=jax.ShapeDtypeStruct((rowsp, _LANES), jnp.float32),
+        interpret=interpret,
+    )(vals.astype(jnp.float32).reshape(mp, 1),
+      idx.astype(jnp.int32).reshape(mp, 1))
+    return out.reshape(-1)[:n]

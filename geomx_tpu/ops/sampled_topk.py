@@ -26,27 +26,42 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def sample_positions(n: int, sample: int = 8192) -> np.ndarray:
+    """Deterministic quasi-random sample positions (Weyl/multiplicative
+    sequence): a plain stride slice would systematically miss magnitude
+    structure correlated with position mod stride; this decorrelates
+    from any fixed layout while staying deterministic (the reference
+    seeds its random sampler the same way every run)."""
+    m = min(n, int(sample))
+    return (np.arange(m, dtype=np.int64) * 2654435761) % n
+
+
+def sampled_boundary(absv: jax.Array, k: int, sample: int = 8192):
+    """The sampled magnitude boundary: the (1 - k/n) quantile of a
+    sorted ~``sample``-element probe of ``absv``.  Shared by the jnp
+    reference scan below and the fused Pallas kernel
+    (ops/bsc_pallas.bsc_select_pack), so both paths select against the
+    bit-identical threshold."""
+    n = absv.shape[0]
+    m = min(n, int(sample))
+    samp = absv[jnp.asarray(sample_positions(n, sample), jnp.int32)]
+    ssorted = jnp.sort(samp)
+    pos = int(round(m * (1.0 - int(k) / n)))
+    return ssorted[min(max(pos, 0), m - 1)]
+
+
 def sampled_threshold_select(v: jax.Array, absv: jax.Array, k: int,
-                             sample: int = 8192):
+                             sample: int = 8192, thr=None):
     """Select ~top-k of ``absv`` by a sampled magnitude boundary.
 
     Returns (vals[k], idx[k] int32 with -1 sentinels, keep[n] bool —
     the dense mask of emitted coordinates, for error-feedback resets).
+    ``thr`` overrides the boundary (callers that already computed it).
     """
     n = absv.shape[0]
     k = int(k)
-    m = min(n, int(sample))
-    # quasi-random sample positions (Weyl/multiplicative sequence): a
-    # plain stride slice would systematically miss magnitude structure
-    # correlated with position mod stride; this decorrelates from any
-    # fixed layout while staying deterministic (the reference seeds its
-    # random sampler the same way every run)
-    pos_idx = (np.arange(m, dtype=np.int64) * 2654435761) % n
-    samp = absv[jnp.asarray(pos_idx, jnp.int32)]
-    ssorted = jnp.sort(samp)
-    # boundary at the (1 - k/n) quantile of the sample
-    pos = int(round(m * (1.0 - k / n)))
-    thr = ssorted[min(max(pos, 0), m - 1)]
+    if thr is None:
+        thr = sampled_boundary(absv, k, sample)
     # two-tier selection: strictly-above-boundary elements claim slots
     # FIRST, boundary-tied elements fill whatever remains.  A plain
     # inclusive mask starves real mass on sparse gradients (thr == 0 ->

@@ -43,19 +43,25 @@ def _kernel(thr, g_ref, r_ref, packed_ref, newr_ref):
     codes = jnp.where(pos, 1, jnp.where(neg, 2, 0)).astype(jnp.int32)
     sent = jnp.where(pos, thr, jnp.where(neg, -thr, 0.0))
     newr_ref[:] = acc - sent
-    # pack: [R, 16*L] -> [R, 16, L] codes; word = sum(code_j << 2j) per lane
-    rows = codes.shape[0]
-    c3 = codes.reshape(rows, _PACK, _LANES)
-    shifts = (jnp.arange(_PACK, dtype=jnp.int32) * 2).reshape(1, _PACK, 1)
-    packed_ref[:] = jnp.sum(c3 << shifts, axis=1, dtype=jnp.int32)
+    # pack: word (row, lane) collects the 16 codes at columns lane + 128*j
+    # (lane-strided).  Sixteen static [R, 128] column slices shifted and
+    # summed elementwise — Mosaic has no middle-axis reduce_sum, so the
+    # [R, 16, L] reshape+reduce formulation does not cross-lower.
+    packed = codes[:, 0 * _LANES:1 * _LANES]
+    for j in range(1, _PACK):
+        packed = packed | (codes[:, j * _LANES:(j + 1) * _LANES] << (2 * j))
+    packed_ref[:] = packed
 
 
 def _dequant_kernel(thr, packed_ref, out_ref):
-    rows = packed_ref.shape[0]
-    shifts = (jnp.arange(_PACK, dtype=jnp.int32) * 2).reshape(1, _PACK, 1)
-    codes = (packed_ref[:].reshape(rows, 1, _LANES) >> shifts) & 3
-    vals = jnp.where(codes == 1, thr, jnp.where(codes == 2, -thr, 0.0))
-    out_ref[:] = vals.reshape(rows, _PACK * _LANES).astype(jnp.float32)
+    # inverse of the lane-strided pack: sixteen static [R, 128] column
+    # stores (no 3-D reshape/broadcast, which Mosaic cannot lower)
+    words = packed_ref[:]
+    for j in range(_PACK):
+        codes = (words >> (2 * j)) & 3
+        out_ref[:, j * _LANES:(j + 1) * _LANES] = jnp.where(
+            codes == 1, thr, jnp.where(codes == 2, -thr, 0.0)
+        ).astype(jnp.float32)
 
 
 def _block_rows(rows: int) -> int:

@@ -103,6 +103,40 @@ class MultiGPSPlan:
             return leaf
         return jax.tree.map(f, tree)
 
+    # ---- composition with tree-fusing dc compressors ---------------------
+
+    def split_mixed(self, orig_sizes: Sequence[int], mixed_leaves):
+        """Partition mixed-tree leaves into (sharded, replicated) groups
+        by the ORIGINAL leaf sizes.
+
+        Tree-fusing dc compressors (tree-level DGT, BucketedCompressor)
+        rank/defer blocks of one flat buffer built from the whole tree.
+        Under MultiGPS that buffer would mix worker-axis shards (content
+        differs per worker slot) with replicated leaves — the send
+        decision then differs across workers and the replicated leaves'
+        aggregates silently diverge within a party (washed out only by
+        stateless optimizers at DGT drain steps).  Splitting into one
+        schedule per layout group makes the replicated group's decisions
+        a function of replicated content only, restoring worker-slot
+        consistency by construction."""
+        big, small = [], []
+        for n0, leaf in zip(orig_sizes, mixed_leaves):
+            (big if self.is_big(n0) else small).append(leaf)
+        return big, small
+
+    def stitch_mixed(self, orig_sizes: Sequence[int], big, small):
+        """Inverse of :meth:`split_mixed` (original leaf order)."""
+        big, small = list(big), list(small)
+        out, bi, si = [], 0, 0
+        for n0 in orig_sizes:
+            if self.is_big(n0):
+                out.append(big[bi])
+                bi += 1
+            else:
+                out.append(small[si])
+                si += 1
+        return out
+
     # ---- inside shard_map ------------------------------------------------
 
     def scatter_grad_leaf(self, g: jax.Array, axis_name: str) -> jax.Array:

@@ -182,10 +182,34 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                     g, ws, WORKER_AXIS, nw)
                 mixed_g.append(g / nw if nw > 1 else g)
                 new_ws.append(ws)
-        mixed_g = treedef.unflatten(mixed_g)
         # dc tier on the mixed tree: big leaves cross the WAN as shards
-        mixed_g, dstate = sync.dc_compressor.allreduce(
-            mixed_g, sync_state["dc_comp"], DC_AXIS, np_)
+        dc = sync.dc_compressor
+        if getattr(dc, "fuses_tree", False):
+            # EXPLICIT composition with tree-fusing compressors (tree-
+            # level DGT): one schedule per layout group.  A single flat
+            # schedule over the whole mixed tree ranks blocks that mix
+            # worker-axis shard content (different per worker slot) with
+            # replicated leaves, so its send decisions differ across
+            # workers and replicated leaves' aggregates diverge within a
+            # party.  The split keeps the replicated group's schedule a
+            # function of replicated content only (see
+            # MultiGPSPlan.split_mixed; state initialized group-wise by
+            # Trainer.init_state).
+            sizes = [p.size for p in flat_p]
+            big, small = mgps.split_mixed(sizes, mixed_g)
+            dst = sync_state["dc_comp"]
+            big_s, small_s = dst["sharded"], dst["replicated"]
+            if big:
+                big, big_s = dc.allreduce(big, big_s, DC_AXIS, np_)
+            if small:
+                small, small_s = dc.allreduce(small, small_s, DC_AXIS, np_)
+            mixed_g = treedef.unflatten(
+                mgps.stitch_mixed(sizes, big, small))
+            dstate = {"sharded": big_s, "replicated": small_s}
+        else:
+            mixed_g, dstate = dc.allreduce(
+                treedef.unflatten(mixed_g), sync_state["dc_comp"],
+                DC_AXIS, np_)
         if np_ > 1:
             mixed_g = jax.tree.map(lambda x: x / np_, mixed_g)
 

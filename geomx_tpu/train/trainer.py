@@ -104,6 +104,18 @@ class Trainer:
             mixed = self._mgps.mixed_example(params)
             opt_state = self.tx.init(mixed)
             sync_state = self.sync.init_state(mixed, model_state=model_state)
+            dc = getattr(self.sync, "dc_compressor", None)
+            if dc is not None and getattr(dc, "fuses_tree", False):
+                # tree-fusing dc compressors (tree-level DGT) run one
+                # flat schedule per layout group under MultiGPS — shard
+                # leaves and replicated leaves must not share blocks
+                # (train/step.py _mgps_sync_update splits the same way)
+                sizes = [l.size for l in jax.tree.leaves(params)]
+                big, small = self._mgps.split_mixed(
+                    sizes, jax.tree.leaves(mixed))
+                sync_state = dict(sync_state, dc_comp={
+                    "sharded": dc.init_state(big),
+                    "replicated": dc.init_state(small)})
         else:
             opt_state = self.tx.init(params)
             sync_state = self.sync.init_state(params,

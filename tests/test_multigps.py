@@ -114,7 +114,13 @@ def test_multigps_composes_with_dc_tier_dgt(topo2x4, rng):
     """The combination the worker-tier rejection message recommends must
     actually work: enable_dgt wraps the dc compressor, whose tree-level
     state the Trainer sizes from the MIXED (shard-shaped) tree — big
-    leaves cross the WAN as 1/W scatter shards under one DGT schedule."""
+    leaves cross the WAN as 1/W scatter shards.  The composition is
+    EXPLICIT: one DGT schedule per layout group (sharded vs replicated,
+    MultiGPSPlan.split_mixed) — a single flat schedule would rank blocks
+    mixing per-worker shard content with replicated leaves, and the
+    replicated leaves' aggregates would silently diverge across worker
+    slots (unrecoverably so under a stateful optimizer, which is why
+    this trains with momentum)."""
     from geomx_tpu.sync import get_sync_algorithm
 
     cfg = GeoConfig(num_parties=2, workers_per_party=4, multi_gps=True,
@@ -122,19 +128,27 @@ def test_multigps_composes_with_dc_tier_dgt(topo2x4, rng):
                     dgt_block_size=256, udp_channel_num=3)
     sync = get_sync_algorithm(cfg)
     assert sync.dc_compressor.name == "dgt"
-    trainer = Trainer(MLP(hidden=(64,)), topo2x4, optax.sgd(0.05),
-                      sync=sync, config=cfg)
+    trainer = Trainer(MLP(hidden=(64,)), topo2x4,
+                      optax.sgd(0.05, momentum=0.9), sync=sync, config=cfg)
     x = (rng.rand(2, 4, 8, 32, 32, 3) * 255).astype(np.uint8)
     y = rng.randint(0, 10, size=(2, 4, 8)).astype(np.int32)
     sharding = topo2x4.batch_sharding(trainer.mesh)
     state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    # the explicit composition: group-wise DGT state, not one flat tree
+    dc_state = state.sync_state["dc_comp"]
+    assert set(dc_state.keys()) == {"sharded", "replicated"}
     losses = []
-    for _ in range(3):
+    for _ in range(6):
         state, metrics = trainer.train_step(
             state, jax.device_put(x, sharding), jax.device_put(y, sharding))
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+    # replica consistency: every (party, worker) slot must hold the same
+    # parameters — the invariant the per-group schedules guarantee
+    for leaf in jax.tree.leaves(state.params):
+        a = np.asarray(leaf)
+        np.testing.assert_array_equal(a, np.broadcast_to(a[:1, :1], a.shape))
 
 
 def test_multigps_rejects_dgt_worker_compressor(topo2x4):
