@@ -54,6 +54,15 @@ Micro-modes:
       re-admission catch-up payload is measured, and the party count /
       WAN wire-volume accounting return to pre-failure values.  CPU, no
       TPU needed (docs/resilience.md).
+  bench.py --compare-telemetry [--model=resnet20] [--iters=6]
+           [--compression=bsc,0.01] [--out-dir=/tmp/...]
+      One JSON line for the telemetry plane (docs/telemetry.md): the
+      GEOMX_TELEMETRY=0 step jaxpr is byte-identical to a probe-excised
+      build, the enabled path's in-graph probe values and measured
+      overhead, a Prometheus exposition round-trip through the strict
+      parser, and a merged 2-party WAN round trace with round_id-linked
+      spans.  Artifacts (merged trace + JSONL event log) land in
+      --out-dir.  CPU, no TPU needed.
 
 Env knobs:
   GEOMX_BENCH_PLATFORM=cpu   debug on the host CPU (tiny shapes)
@@ -89,6 +98,7 @@ import queue
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -694,6 +704,29 @@ def _fit_overhead(batch, iters, bare_sps):
 
 
 def child_main():
+    # watchdog diagnosability (BENCH_r05 burned 2x480s with zero clue
+    # where init hung): the parent sends SIGUSR1 before killing a
+    # wedged child, and faulthandler dumps EVERY thread's stack to
+    # stderr — which the parent attaches to the published error.  The
+    # per-phase timestamps below bound WHICH init phase ate the budget.
+    t_child0 = time.monotonic()
+
+    def _phase(name):
+        _emit({"event": "phase", "phase": name,
+               "elapsed_s": round(time.monotonic() - t_child0, 2)})
+    try:
+        import faulthandler
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError, OSError):
+        pass  # non-main thread / unsupported platform: dumps just absent
+    _phase("child_start")
+    hang = os.environ.get("GEOMX_BENCH_FAULT_HANG_INIT")
+    if hang:
+        # test hook: wedge init deterministically so the watchdog's
+        # forensic path (SIGUSR1 stack dump + per-phase timestamps) is
+        # exercisable in seconds instead of a real 480s hang
+        time.sleep(float(hang))
+
     # validate the config filter BEFORE backend init: the name list is
     # static, and a typo must fail in a second, not after a 480s tunnel
     # init (and without triggering a guaranteed-futile resume respawn)
@@ -709,7 +742,9 @@ def child_main():
     import jax
     if platform:
         jax.config.update("jax_platforms", platform)
+    _phase("jax_imported")
     devs = jax.devices()
+    _phase("devices_enumerated")
     on_tpu = devs[0].platform == "tpu"
     # persistent compile cache: a fresh bench process pays 20-40s of
     # tunnel compiles per program; the repo-local cache makes every run
@@ -725,6 +760,7 @@ def child_main():
             path=None if os.environ.get("GEOMX_COMPILE_CACHE")
             else os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               ".geomx_compile_cache"))
+    _phase("compile_cache_ready")
     kind = devs[0].device_kind
     peak = _peak_flops(kind) if on_tpu else None
     # compute-gate the backend-up signal: on a tunneled chip
@@ -738,6 +774,7 @@ def child_main():
         a = jax.device_put(jnp.ones((256, 256)), d)
         probe = float(jnp.sum(a @ a))
         assert probe == 256.0 * 256 * 256, (d, probe)
+    _phase("device_probe_done")
     _emit({"event": "backend_up", "platform": devs[0].platform,
            "device_kind": kind, "n_devices": len(devs),
            "peak_bf16_flops": peak})
@@ -1576,6 +1613,230 @@ def compare_resilience_main(argv):
 
 
 # --------------------------------------------------------------------------
+# --compare-telemetry: the unified telemetry plane's acceptance mode
+# --------------------------------------------------------------------------
+
+
+def _host_plane_trace(out_dir: str) -> dict:
+    """A 2-party in-process WAN round with per-party profilers: two
+    local GeoPSServers relay to one global server, every server dumps a
+    Chrome trace, and merge_traces folds them into ONE timeline whose
+    push/merge/relay/pull spans share a round_id per WAN round.  Writes
+    the merged trace (and the per-rank dumps) into ``out_dir``; returns
+    the linkage verdict."""
+    import json as _json
+
+    import numpy as np
+
+    from geomx_tpu.service import GeoPSClient, GeoPSServer
+    from geomx_tpu.telemetry import merge_traces, rounds_in_trace
+
+    glob = GeoPSServer(num_workers=2, mode="sync", rank=0).start()
+    locs = [GeoPSServer(num_workers=1, mode="sync", rank=r + 1,
+                        global_addr=("127.0.0.1", glob.port)).start()
+            for r in range(2)]
+    for s in (glob, *locs):
+        s.profiler.set_state(True)
+    clients = [GeoPSClient(("127.0.0.1", s.port), sender_id=i)
+               for i, s in enumerate(locs)]
+    merged_path = os.path.join(out_dir, "geomx_telemetry_merged_trace.json")
+    try:
+        for c in clients:
+            c.init("w", np.zeros((64,), np.float32))
+        rounds_run = 2
+        for _ in range(rounds_run):
+            for i, c in enumerate(clients):
+                c.push("w", np.full((64,), float(i + 1), np.float32))
+            for c in clients:
+                c.pull("w", timeout=60.0)
+        paths = [s.profiler.dump(os.path.join(
+            out_dir, f"geomx_telemetry_rank{s.rank}.json"))
+            for s in (glob, *locs)]
+        merged = merge_traces(paths, labels=["global", "party0", "party1"])
+        with open(merged_path, "w") as f:
+            _json.dump(merged, f)
+        rounds = {rk: evs for rk, evs in rounds_in_trace(merged).items()
+                  if rk[0] == "w"}
+        # every WAN round must appear on BOTH sides of the wire: spans
+        # from >= 2 processes (a party's relay + the global's merge)
+        linked = bool(rounds) and all(
+            len(evs) >= 3 and len({e["pid"] for e in evs}) >= 2
+            for evs in rounds.values())
+    finally:
+        for c in clients:
+            c.stop_server()
+            c.close()
+        glob.join(10)
+        for s in locs:
+            s.join(10)
+    return {"wan_rounds_traced": len(rounds),
+            "trace_rounds_linked": linked,
+            "merged_trace": merged_path}
+
+
+def _compare_telemetry(model_name: str = "resnet20", batch: int = 64,
+                       iters: int = 6, compression: str = "bsc,0.01",
+                       out_dir: str = None):
+    """The telemetry acceptance run on a 2-party CPU mesh:
+
+    1. disabled path — the traced step's jaxpr must be byte-identical
+       (addresses canonicalized) to a build with the probe module
+       excised, and the probe collector must never be called;
+    2. enabled path — run real steps, read the in-graph probe values
+       back, and measure the overhead against the disabled path;
+    3. export plane — publish the probes, render the registry as
+       Prometheus text and round-trip it through the strict parser;
+    4. tracing plane — an in-process 2-party host-plane round produces
+       one merged Chrome trace with round_id-linked WAN spans.
+
+    One JSON line out, artifacts (merged trace + JSONL event log) in
+    ``out_dir`` for CI to upload.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.telemetry import (parse_prometheus_text,
+                                     render_prometheus)
+    from geomx_tpu.telemetry import probes as probes_mod
+    from geomx_tpu.telemetry.probes import canonicalize_jaxpr
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "compare-telemetry needs >= 2 devices for the dc axis (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+    out_dir = out_dir or tempfile.mkdtemp(prefix="geomx_telemetry_")
+    os.makedirs(out_dir, exist_ok=True)
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    local_b = max(1, batch // 2)
+    rng = np.random.RandomState(0)
+    x = (rng.rand(2, 1, local_b, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(2, 1, local_b)).astype(np.int32)
+    events_path = os.path.join(out_dir, "geomx_telemetry_events.jsonl")
+    try:
+        os.unlink(events_path)
+    except OSError:
+        pass
+
+    def build(telemetry: bool):
+        cfg = GeoConfig(num_parties=2, workers_per_party=1,
+                        compression=compression, telemetry=telemetry,
+                        telemetry_events=events_path if telemetry else "")
+        return Trainer(get_model(model_name, num_classes=10), topo,
+                       optax.sgd(0.1, momentum=0.9),
+                       sync=get_sync_algorithm(cfg), config=cfg,
+                       donate=False)
+
+    def time_steps(trainer, state):
+        state, m = trainer.train_step(state, xb, yb)  # compile + warm
+        state, m = trainer.train_step(state, xb, yb)
+        jax.block_until_ready(m["loss"])
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, m = trainer.train_step(state, xb, yb)
+            jax.block_until_ready(m["loss"])
+            d = (time.perf_counter() - t0) / iters
+            best = d if best is None else min(best, d)
+        return best, state, m
+
+    # -- disabled path: jaxpr identity vs a probe-excised build --------------
+    saved_env = os.environ.pop("GEOMX_TELEMETRY", None)
+    try:
+        tr_off = build(False)
+        sharding = topo.batch_sharding(tr_off.mesh)
+        xb = jax.device_put(x, sharding)
+        yb = jax.device_put(y, sharding)
+        state_off = tr_off.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+        jaxpr_off = canonicalize_jaxpr(str(
+            jax.make_jaxpr(tr_off.train_step)(state_off, xb, yb)))
+        probe_calls = {"n": 0}
+        orig = probes_mod.collect_step_probes
+
+        def _raiser(*a, **k):
+            probe_calls["n"] += 1
+            raise AssertionError("probe collector ran on the disabled path")
+
+        probes_mod.collect_step_probes = _raiser
+        try:
+            tr_base = build(False)
+            jaxpr_base = canonicalize_jaxpr(str(
+                jax.make_jaxpr(tr_base.train_step)(state_off, xb, yb)))
+        finally:
+            probes_mod.collect_step_probes = orig
+        jaxpr_identical = (jaxpr_off == jaxpr_base
+                           and probe_calls["n"] == 0)
+        t_off, state_off, _ = time_steps(tr_off, state_off)
+
+        # -- enabled path: probe values + overhead ---------------------------
+        tr_on = build(True)
+        state_on = tr_on.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+        t_on, state_on, m = time_steps(tr_on, state_on)
+        m = jax.device_get(m)
+        telem = m.get("telemetry", {})
+        probes_out = {
+            k: (float(v) if np.ndim(v) == 0
+                else [float(u) for u in np.asarray(v)])
+            for k, v in sorted(telem.items())}
+        tr_on._publish_telemetry(telem, iteration=iters)
+        overhead_pct = 100.0 * (t_on - t_off) / t_off if t_off else 0.0
+
+        # -- export plane: registry -> text -> strict parser ----------------
+        text = render_prometheus()
+        parsed = parse_prometheus_text(text)
+        prometheus_valid = ("geomx_step_probe" in parsed
+                            and any(parsed[f]["samples"]
+                                    for f in parsed))
+
+        # -- tracing plane: merged 2-party WAN round trace -------------------
+        trace_info = _host_plane_trace(out_dir)
+    finally:
+        if saved_env is not None:
+            os.environ["GEOMX_TELEMETRY"] = saved_env
+
+    return {
+        "mode": "compare_telemetry", "model": model_name,
+        "compression": compression, "batch": batch, "iters": iters,
+        "probes": probes_out,
+        "step_time_ms_off": round(t_off * 1e3, 3),
+        "step_time_ms_on": round(t_on * 1e3, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_within_bound": overhead_pct <= 2.0,
+        "jaxpr_identical_when_disabled": bool(jaxpr_identical),
+        "disabled_path_probe_calls": probe_calls["n"],
+        "prometheus_valid": bool(prometheus_valid),
+        "prometheus_families": len(parsed),
+        "wan_rounds_traced": trace_info["wan_rounds_traced"],
+        "trace_rounds_linked": trace_info["trace_rounds_linked"],
+        "artifacts": {"merged_trace": trace_info["merged_trace"],
+                      "event_log": events_path},
+    }
+
+
+def compare_telemetry_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--compression="):
+            kwargs["compression"] = a.split("=", 1)[1]
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--iters="):
+            kwargs["iters"] = int(a.split("=", 1)[1])
+        elif a.startswith("--out-dir="):
+            kwargs["out_dir"] = a.split("=", 1)[1]
+    _emit(_compare_telemetry(**kwargs))
+
+
+# --------------------------------------------------------------------------
 # parent: watchdog + single-line aggregation
 # --------------------------------------------------------------------------
 
@@ -1603,6 +1864,9 @@ def _run_attempt(init_timeout, total_timeout, results, on_event=None,
     env = dict(os.environ, GEOMX_BENCH_CHILD="1")
     env.pop("GEOMX_BENCH_DONE", None)
     env.pop("GEOMX_BENCH_BARE_SPS", None)
+    # per-ATTEMPT phase trail: a watchdog bundle must diagnose the child
+    # that hung, not inherit how far some earlier attempt got
+    results.pop("init_phases", None)
     if extra_env:
         env.update(extra_env)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
@@ -1613,13 +1877,15 @@ def _run_attempt(init_timeout, total_timeout, results, on_event=None,
     threading.Thread(target=_drain, args=(proc.stdout, q),
                      daemon=True).start()
     stderr_buf = []
-    threading.Thread(target=lambda: stderr_buf.extend(
-        proc.stderr.read().splitlines()[-20:]), daemon=True).start()
+    stderr_thread = threading.Thread(target=lambda: stderr_buf.extend(
+        proc.stderr.read().splitlines()[-200:]), daemon=True)
+    stderr_thread.start()
 
     t_start = time.monotonic()
     t_backend = None
     error = None
     done = False
+    watchdog_fired = None
 
     while True:
         if t_backend is None:
@@ -1633,6 +1899,16 @@ def _run_attempt(init_timeout, total_timeout, results, on_event=None,
         except queue.Empty:
             error = (f"watchdog: {phase} exceeded {budget:g}s — "
                      "TPU backend hung or config wedged")
+            watchdog_fired = phase
+            # diagnosability (BENCH_r05: two silent 480s burns): ask the
+            # child for all-thread stack dumps (faulthandler is
+            # registered on SIGUSR1 in child_main) and give it a moment
+            # to flush stderr before the kill
+            try:
+                proc.send_signal(signal.SIGUSR1)
+                time.sleep(2.0)
+            except (OSError, AttributeError):
+                pass
             proc.kill()
             break
         if line is None:  # child exited (rc checked after the reap below)
@@ -1645,7 +1921,12 @@ def _run_attempt(init_timeout, total_timeout, results, on_event=None,
         except json.JSONDecodeError:
             continue
         kind = ev.pop("event", None)
-        if kind == "backend_up":
+        if kind == "phase":
+            # per-phase init timestamps: bounds WHICH phase a later
+            # watchdog trip was stuck in
+            results.setdefault("init_phases", {})[
+                str(ev.get("phase"))] = ev.get("elapsed_s")
+        elif kind == "backend_up":
             t_backend = time.monotonic()
             results["backend"] = ev
         elif kind == "config":
@@ -1672,10 +1953,25 @@ def _run_attempt(init_timeout, total_timeout, results, on_event=None,
         proc.wait(timeout=10)
     except subprocess.TimeoutExpired:
         proc.kill()
+    stderr_thread.join(timeout=5)
     if error is None and not done and proc.poll() not in (0, None):
         # stdout EOF can arrive before the process is reaped; re-check
         # so a crashed child is reported, not silently absorbed
         error = f"bench child exited rc={proc.poll()}"
+    if watchdog_fired is not None:
+        # the full diagnostic rides the record (structured, not crammed
+        # into the error string): which phase hung, how far init got,
+        # and the child's all-thread stacks at kill time
+        results["watchdog"] = {
+            "phase": watchdog_fired,
+            "init_phases": dict(results.get("init_phases", {})),
+            "stacks": stderr_buf[-120:],
+        }
+        phases = results.get("init_phases", {})
+        if phases:
+            last = max(phases, key=lambda k: phases[k] or 0)
+            error += (f" | last init phase: {last} at "
+                      f"{phases[last]}s; stacks in watchdog.stacks")
     if error is not None and stderr_buf:
         error += " | " + " | ".join(stderr_buf[-5:])[-2000:]
     return t_backend is not None, error
@@ -1775,6 +2071,12 @@ def _aggregate(results, error, attempt_log, partial):
         # fallback's — real measurements, wrong hardware, flagged so
         # no reader mistakes them for chip throughput (or for a 0.0)
         out["degraded"] = True
+    if results.get("init_phases"):
+        out["init_phases"] = results["init_phases"]
+    if results.get("watchdog"):
+        # the watchdog's forensic bundle: hung phase, per-phase init
+        # timestamps, and the child's all-thread stack dumps at kill
+        out["watchdog"] = results["watchdog"]
     if partial:
         out["partial"] = True
     if error is not None:
@@ -1923,6 +2225,16 @@ def main():
         os.environ.setdefault("JAX_PLATFORMS",
                               os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
         compare_kernels_main(sys.argv[1:])
+    elif "--compare-telemetry" in sys.argv:
+        # telemetry acceptance micro-mode: in-process on the CPU backend
+        # with a 2-device virtual mesh (env before the first jax import)
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2").strip()
+        compare_telemetry_main(sys.argv[1:])
     elif "--compare-resilience" in sys.argv:
         # chaos/structure micro-mode like --compare-pipeline: in-process
         # on the CPU backend with a 2-device virtual mesh

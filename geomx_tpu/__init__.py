@@ -23,7 +23,11 @@ attention (``parallel.ring_attention``) and Ulysses all-to-all
 elastic resilience (``resilience``): versioned party-membership epochs,
 degraded-mode WAN sync that renormalizes the dc-tier mean over surviving
 parties, re-admission catch-up, and a deterministic seeded chaos harness
-(docs/resilience.md).
+(docs/resilience.md); and a unified telemetry plane (``telemetry``):
+in-graph gradient-health probes whose disabled path is jaxpr-identical
+to a telemetry-free build, a process-global metric registry with
+Prometheus export, cross-party WAN round tracing with merged Chrome
+timelines, and a bounded JSONL event log (docs/telemetry.md).
 
 Synchronization algorithms: FSA (fully-synchronous, default), MixedSync
 (async global tier with optional DCASGD delay compensation), and HFA

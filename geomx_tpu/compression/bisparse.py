@@ -119,6 +119,7 @@ class BiSparseCompressor(Compressor):
 
         Returns (values[k], indices[k], new_u, new_v).
         """
+        from geomx_tpu.telemetry.probes import record_inline
         n = g_flat.shape[0]
         k = self.k_for(n)
         if self.fused_select:
@@ -131,8 +132,15 @@ class BiSparseCompressor(Compressor):
             thr = sampled_boundary_guv(g_flat, u, v, k)
             with profile_scope("bsc/select_pack", category="kernel",
                               args={"n": n, "k": k}):
-                return bsc_select_pack(g_flat, u, v, thr, k,
-                                       interpret=self.fused_interpret)
+                vals, idx, u, v = bsc_select_pack(
+                    g_flat, u, v, thr, k, interpret=self.fused_interpret)
+            # in-situ achieved payload (telemetry/probes.py): the
+            # sampled boundary emits <= k real pairs, the rest ride as
+            # sentinels — wasted wire the configured ratio hides.  The
+            # thunk keeps the disabled path op-free.
+            record_inline("bsc_emitted_fraction",
+                          lambda: jnp.sum(idx >= 0) / k)
+            return vals, idx, u, v
         u = u * MOMENTUM + g_flat
         v = v + u
         absv = jnp.abs(v)
@@ -144,6 +152,8 @@ class BiSparseCompressor(Compressor):
             # error feedback: emitted coordinates reset (gc.cc:250-252)
             v = jnp.where(keep, 0.0, v)
             u = jnp.where(keep, 0.0, u)
+            record_inline("bsc_emitted_fraction",
+                          lambda: jnp.sum(idx >= 0) / k)
             return vals, idx, u, v
         if self.select == "approx":
             _, idx = lax.approx_max_k(absv, k)
@@ -153,6 +163,8 @@ class BiSparseCompressor(Compressor):
         # error feedback: sent coordinates reset in both buffers (gc.cc:250-252)
         v = v.at[idx].set(0.0)
         u = u.at[idx].set(0.0)
+        # exact/approx top-k always fills all k slots
+        record_inline("bsc_emitted_fraction", lambda: jnp.ones((), jnp.float32))
         return vals, idx.astype(jnp.int32), u, v
 
     def decompress(self, vals: jax.Array, idx: jax.Array, n: int) -> jax.Array:

@@ -293,6 +293,22 @@ class BucketedCompressor(Compressor):
         bk = self._bucketer([leaf])
         return self.inner.wire_bytes_leaf(_bucket_leaf(bk.bucket_sizes[0]))
 
+    def layout_summary(self) -> Optional[dict]:
+        """Static summary of the largest cached bucket layout (the
+        gradient tree's), for the telemetry plane's host-side gauges
+        (geomx_bucket_*): bucket count and the lane-padding waste the
+        wire actually carries.  None before the first trace resolved a
+        layout."""
+        if not self._bucketers:
+            return None
+        bk = max(self._bucketers.values(),
+                 key=lambda b: sum(b.bucket_fill) if b.bucket_fill else 0)
+        fill = float(sum(bk.bucket_fill))
+        padded = float(sum(bk.bucket_sizes))
+        return {"num_buckets": bk.num_buckets,
+                "bucket_elems": fill, "padded_elems": padded,
+                "pad_fraction": (padded - fill) / padded if padded else 0.0}
+
     def bucket_report(self, grads: Any) -> List[dict]:
         """Per-bucket payload table (what bench's --compare-bucketing and
         the profiler spans report): true/padded elements, member-leaf

@@ -116,6 +116,17 @@ class GeoConfig:
     heartbeat_interval_s: float = 0.0  # PS_HEARTBEAT_INTERVAL; 0 disables
     heartbeat_timeout_s: float = 15.0  # PS_HEARTBEAT_TIMEOUT
 
+    # ---- telemetry (telemetry/: in-graph step probes, metric registry,
+    # Prometheus export; docs/telemetry.md).  Off by default: the
+    # disabled step program is jaxpr-identical to a telemetry-free
+    # build.  GEOMX_TELEMETRY is also honored directly by
+    # telemetry.probes.telemetry_enabled for config-less call sites.
+    telemetry: bool = False
+    # structured JSONL event log path ("" = disabled); the file is
+    # size-bounded (GEOMX_TELEMETRY_EVENTS_MAX_BYTES, default 16 MiB,
+    # one rotation generation)
+    telemetry_events: str = ""
+
     # ---- resilience (resilience/: membership epochs, degraded-mode sync,
     # deterministic chaos; docs/resilience.md)
     # residual policy at a membership change: "reset" re-initializes
@@ -170,6 +181,8 @@ class GeoConfig:
                 ["GEOMX_HEARTBEAT_INTERVAL", "PS_HEARTBEAT_INTERVAL"], 0.0, float),
             heartbeat_timeout_s=_env(
                 ["GEOMX_HEARTBEAT_TIMEOUT", "PS_HEARTBEAT_TIMEOUT"], 15.0, float),
+            telemetry=_env_bool(["GEOMX_TELEMETRY"], False),
+            telemetry_events=_env(["GEOMX_TELEMETRY_EVENTS"], "", str),
             resilience_residuals=_env(
                 ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
             resilience_min_live=_env(

@@ -204,9 +204,40 @@ class PartyLivenessController:
         # subscribers run OUTSIDE the lock: a callback is free to read
         # .epoch or trigger further transitions without deadlocking
         if changed:
+            try:
+                self._record_epoch(epoch)
+            except Exception:
+                # telemetry must never abort the membership publish: an
+                # unwritable event log (full disk mid-failure) or a bad
+                # GEOMX_TELEMETRY_EVENTS_MAX_BYTES would otherwise skip
+                # every subscriber and leave degraded sync unconfigured
+                pass
             for cb in list(self._subs):
                 cb(epoch)
         return epoch
+
+    def _record_epoch(self, epoch: MembershipEpoch) -> None:
+        """Membership telemetry (docs/telemetry.md): the epoch version
+        and live-party gauges answer "is the mesh degraded RIGHT NOW and
+        since which transition" without scraping logs, and the event log
+        keeps the transition history with masks."""
+        from geomx_tpu.telemetry import get_registry, log_event
+        reg = get_registry()
+        reg.gauge("geomx_membership_version",
+                  "Version of the current membership epoch"
+                  ).set(epoch.version)
+        reg.gauge("geomx_live_parties",
+                  "Parties contributing to the dc-tier aggregate"
+                  ).set(epoch.num_live)
+        per_party = reg.gauge("geomx_party_live",
+                              "Per-party liveness (1 = live)", ("party",))
+        for p, ok in enumerate(epoch.live_mask):
+            per_party.labels(party=str(p)).set(1.0 if ok else 0.0)
+        reg.counter("geomx_membership_transitions_total",
+                    "Published membership epoch changes").inc()
+        log_event("membership_epoch", version=epoch.version,
+                  live_mask=list(epoch.live_mask),
+                  num_live=epoch.num_live)
 
 
 # ---- re-admission catch-up ------------------------------------------------

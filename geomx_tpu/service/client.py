@@ -446,6 +446,13 @@ class GeoPSClient:
             g = g.astype(np.float32, copy=False)
         rnd = self._key_rounds.get(key, 0) + 1
         self._key_rounds[key] = rnd
+        # round-correlated client span (telemetry/tracing.py): the same
+        # round_id the server threads through merge/relay/pull, so a
+        # worker-side trace merges onto the WAN round timeline.  No-op
+        # unless the process profiler is running.
+        from geomx_tpu.utils.profiler import get_profiler
+        get_profiler().instant(f"ClientPush:{key}", "kvstore",
+                               args={"key": key, "round_id": rnd})
         if self._slicer is not None and g.size > self.p3_slice_elems \
                 and not meta:
             # P3: slice into priority-tagged chunks; each is an independent
@@ -1011,6 +1018,14 @@ class GeoPSClient:
         reply = self._request(Msg(MsgType.COMMAND,
                                   meta={"cmd": "wire_stats"}))
         return dict(reply.meta["stats"])
+
+    def metrics_text(self) -> str:
+        """The SERVER process's live Prometheus exposition
+        (telemetry/export.py) — ``COMMAND {cmd: "metrics"}``, the
+        wire-protocol twin of the scheduler's GET /metrics."""
+        reply = self._request(Msg(MsgType.COMMAND,
+                                  meta={"cmd": "metrics"}))
+        return str(reply.meta["text"])
 
     def num_dead_nodes(self, timeout: Optional[float] = None) -> int:
         reply = self._request(Msg(MsgType.COMMAND,

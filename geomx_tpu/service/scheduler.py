@@ -21,6 +21,15 @@ COMMAND protocol:
 - ``barrier`` blocks until ``expect`` nodes enter (the per-tier Barrier);
 - heartbeats feed the shared dead-node detector.
 
+Telemetry (docs/telemetry.md): the scheduler is the cluster's natural
+scrape point, so it can serve the process-global metric registry as
+Prometheus text — ``metrics_port=0`` (or ``GEOMX_METRICS_PORT``) starts
+a tiny HTTP endpoint answering ``GET /metrics``, and ``COMMAND
+{cmd: "metrics"}`` returns the same exposition over the framework wire
+protocol.  Roster churn (registrations, evictions, epoch bumps) is
+recorded as gauges/counters and as profiler instants carrying the
+roster epoch, so membership events line up with the WAN round trace.
+
 `scripts/launch.py` starts one per job when GEOMX_USE_SCHEDULER=1 and
 `examples/dist_ps.py` then discovers every address through it.
 """
@@ -31,6 +40,7 @@ import os
 import pickle
 import socket
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from geomx_tpu.service.protocol import (Msg, MsgType, connect_retry,
@@ -45,7 +55,8 @@ class GeoScheduler:
     reference's scheme) + roster + barrier."""
 
     def __init__(self, port: int = 0, bind_host: Optional[str] = None,
-                 heartbeat_timeout: float = 15.0):
+                 heartbeat_timeout: float = 15.0,
+                 metrics_port: Optional[int] = None):
         self._lock = threading.Lock()
         # (role, host, port, tag) -> assigned id; survives re-registration
         # (tag disambiguates nodes with no serving port, e.g. workers
@@ -74,6 +85,74 @@ class GeoScheduler:
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
 
+        # ---- telemetry plane -------------------------------------------
+        from geomx_tpu.telemetry import get_registry
+        reg = get_registry()
+        self._m_epoch = reg.gauge(
+            "geomx_scheduler_roster_epoch",
+            "Roster epoch: bumps on every membership-visible mutation")
+        self._m_nodes = reg.gauge(
+            "geomx_scheduler_nodes",
+            "Nodes currently in the roster, per role", ("role",))
+        self._m_regs = reg.counter(
+            "geomx_scheduler_registrations_total",
+            "Node registrations handled (incl. recoveries)", ("role",))
+        self._m_evicts = reg.counter(
+            "geomx_scheduler_evictions_total",
+            "Nodes evicted from the roster")
+        self._m_barriers = reg.counter(
+            "geomx_scheduler_barrier_releases_total",
+            "Barrier groups released")
+        self._m_hb = reg.counter(
+            "geomx_scheduler_heartbeats_total",
+            "Heartbeats received")
+        self._m_req_s = reg.histogram(
+            "geomx_scheduler_request_seconds",
+            "Scheduler request handling latency")
+        # Prometheus scrape endpoint: explicit metrics_port wins, else
+        # GEOMX_METRICS_PORT (0 = ephemeral), else no HTTP surface
+        self._metrics_srv = None
+        self.metrics_port: Optional[int] = None
+        if metrics_port is None:
+            raw = os.environ.get("GEOMX_METRICS_PORT")
+            if raw not in (None, ""):
+                try:
+                    metrics_port = int(raw)
+                except ValueError:
+                    raise ValueError(
+                        f"Bad value for env var GEOMX_METRICS_PORT: {raw!r}")
+        if metrics_port is not None:
+            self._start_metrics_http(bind_host, int(metrics_port))
+
+    def _start_metrics_http(self, bind_host: str, port: int) -> None:
+        """Serve ``GET /metrics`` (Prometheus text exposition of the
+        process-global registry) from a daemon HTTP thread."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(h):
+                from geomx_tpu.telemetry import render_prometheus
+                from geomx_tpu.telemetry.export import CONTENT_TYPE
+                if h.path.partition("?")[0].rstrip("/") in ("", "/metrics"):
+                    body = render_prometheus().encode("utf-8")
+                    h.send_response(200)
+                    h.send_header("Content-Type", CONTENT_TYPE)
+                    h.send_header("Content-Length", str(len(body)))
+                    h.end_headers()
+                    h.wfile.write(body)
+                else:
+                    h.send_response(404)
+                    h.end_headers()
+
+            def log_message(self, *args):  # no per-scrape stderr noise
+                pass
+
+        self._metrics_srv = ThreadingHTTPServer((bind_host, port), _Handler)
+        self._metrics_srv.daemon_threads = True
+        self.metrics_port = self._metrics_srv.server_address[1]
+        threading.Thread(target=self._metrics_srv.serve_forever,
+                         name="sched-metrics-http", daemon=True).start()
+
     def start(self):
         self._thread.start()
         return self
@@ -84,6 +163,12 @@ class GeoScheduler:
             self._srv.close()
         except OSError:
             pass
+        if self._metrics_srv is not None:
+            try:
+                self._metrics_srv.shutdown()
+                self._metrics_srv.server_close()
+            except OSError:
+                pass
 
     def join(self, timeout: Optional[float] = None):
         self._thread.join(timeout)
@@ -110,12 +195,17 @@ class GeoScheduler:
                 return
             if msg is None:
                 return
+            t0 = time.monotonic()
             try:
                 if self._handle(conn, msg):
                     return
             except Exception as e:
                 self._reply(conn, msg, Msg(MsgType.ERROR,
                                            meta={"error": repr(e)}))
+            finally:
+                # barrier waits park the CONNECTION, not this handler, so
+                # the latency histogram measures real handling time
+                self._m_req_s.observe(time.monotonic() - t0)
 
     def _reply(self, conn, req: Msg, reply: Msg):
         rid = req.meta.get("rid")
@@ -123,10 +213,16 @@ class GeoScheduler:
             reply.meta["rid"] = rid
         send_frame(conn, reply)
 
+    def _roster_gauges_locked(self) -> None:
+        """Refresh the per-role node gauges (caller holds self._lock)."""
+        for role, entries in self._roster.items():
+            self._m_nodes.labels(role=role).set(len(entries))
+
     def _handle(self, conn, msg: Msg) -> bool:
         if msg.type == MsgType.HEARTBEAT:
             if msg.sender >= 0:
                 self.heartbeats.heartbeat(msg.sender)
+            self._m_hb.inc()
             self._reply(conn, msg, Msg(MsgType.ACK))
             return False
         if msg.type == MsgType.STOP:
@@ -172,6 +268,17 @@ class GeoScheduler:
                 self._epoch += 1
                 epoch = self._epoch
                 roster = {r: list(v) for r, v in self._roster.items()}
+                self._roster_gauges_locked()
+                # inside the lock: concurrent register/evict handlers
+                # must publish epochs in bump order, or the scraped
+                # gauge can regress behind the real epoch
+                self._m_epoch.set(epoch)
+            self._m_regs.labels(role=role).inc()
+            from geomx_tpu.utils.profiler import get_profiler
+            get_profiler().instant(
+                "SchedulerRegister", "scheduler",
+                args={"node": node_id, "role": role, "epoch": epoch,
+                      "recovery": bool(recovery)})
             self.heartbeats.heartbeat(node_id)
             self._reply(conn, msg, Msg(MsgType.ACK, meta={
                 "node_id": node_id, "is_recovery": bool(recovery),
@@ -202,6 +309,16 @@ class GeoScheduler:
                 if evicted:
                     self._epoch += 1
                 epoch = self._epoch
+                self._roster_gauges_locked()
+                if evicted:
+                    self._m_epoch.set(epoch)  # in-lock: bump order
+            if evicted:
+                self._m_evicts.inc()
+            from geomx_tpu.utils.profiler import get_profiler
+            get_profiler().instant(
+                "SchedulerEvict", "scheduler",
+                args={"node": node, "epoch": epoch,
+                      "evicted": bool(evicted)})
             self.heartbeats.unregister(node)
             self._reply(conn, msg, Msg(MsgType.ACK, meta={
                 "evicted": evicted, "epoch": epoch}))
@@ -221,6 +338,13 @@ class GeoScheduler:
                         except OSError:
                             pass
                     self._barriers[group] = []
+                    self._m_barriers.inc()
+        elif cmd == "metrics":
+            # the wire-protocol twin of GET /metrics: the same Prometheus
+            # exposition, for clients already speaking COMMAND frames
+            from geomx_tpu.telemetry import render_prometheus
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={
+                "text": render_prometheus()}))
         elif cmd == "num_dead_nodes":
             self._reply(conn, msg, Msg(MsgType.ACK, meta={
                 "dead": self.heartbeats.dead_nodes(
@@ -375,6 +499,12 @@ class SchedulerClient:
         Postoffice::GetDeadNodes surfaced via the scheduler role)."""
         return list(self._rpc(Msg(MsgType.COMMAND, meta={
             "cmd": "num_dead_nodes", "timeout": timeout})).meta["dead"])
+
+    def metrics_text(self) -> str:
+        """The scheduler process's Prometheus exposition over the wire
+        protocol (the COMMAND twin of its GET /metrics endpoint)."""
+        return str(self._rpc(Msg(MsgType.COMMAND,
+                                 meta={"cmd": "metrics"})).meta["text"])
 
     def stop_scheduler(self) -> None:
         try:

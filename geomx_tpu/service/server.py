@@ -192,6 +192,35 @@ class GeoPSServer:
         # kvstore_dist_server.h:383-430)
         from geomx_tpu.utils.profiler import Profiler
         self.profiler = Profiler(rank=rank)
+        # telemetry plane (docs/telemetry.md): per-rank series in the
+        # process-global registry, children bound once here so the push
+        # hot path pays a method call, not a label lookup
+        from geomx_tpu.telemetry import get_registry
+        _reg = get_registry()
+        _r = str(rank)
+        self._m_pushes = _reg.counter(
+            "geomx_server_pushes_total",
+            "PUSH messages merged or relayed", ("rank",)).labels(_r)
+        self._m_pulls = _reg.counter(
+            "geomx_server_pulls_total",
+            "PULL requests answered or parked", ("rank",)).labels(_r)
+        self._m_rounds = _reg.counter(
+            "geomx_server_rounds_total",
+            "Completed sync rounds (per key)", ("rank",)).labels(_r)
+        self._m_relay_fail = _reg.counter(
+            "geomx_server_relay_failures_total",
+            "WAN relays that failed terminally", ("rank",)).labels(_r)
+        self._m_relay_s = _reg.histogram(
+            "geomx_server_relay_seconds",
+            "WAN relay round-trip (push-through + pull-back)",
+            ("rank",)).labels(_r)
+        self._m_evictions = _reg.counter(
+            "geomx_server_evictions_total",
+            "Workers evicted from the sync gate", ("rank",)).labels(_r)
+        self._m_workers = _reg.gauge(
+            "geomx_server_num_workers",
+            "Current sync-gate width", ("rank",)).labels(_r)
+        self._m_workers.set(num_workers)
 
         # MultiGPS: N global servers with reference placement (hash small
         # tensors whole, split big ones across all servers —
@@ -671,6 +700,13 @@ class GeoPSServer:
             self._reply(conn, msg, Msg(MsgType.ACK,
                                        meta={"num_workers": n}))
             return
+        elif cmd == "metrics":
+            # live Prometheus exposition of the process-global registry
+            # (the wire-protocol twin of the scheduler's GET /metrics)
+            from geomx_tpu.telemetry import render_prometheus
+            self._reply(conn, msg, Msg(MsgType.ACK,
+                                       meta={"text": render_prometheus()}))
+            return
         elif cmd == "wire_stats":
             # this server process's Van-style byte/message counters
             # (reference van.h:182-183 send_bytes_/recv_bytes_)
@@ -817,10 +853,13 @@ class GeoPSServer:
         for i, c in enumerate(self._gclients):
             c.init(key, flat[b[i]:b[i + 1]], meta={"reliable": True})
 
-    def _relay_to_global(self, key: str, grad: np.ndarray) -> np.ndarray:
+    def _relay_to_global(self, key: str, grad: np.ndarray,
+                         round_: Optional[int] = None) -> np.ndarray:
         """Push the party aggregate up, pull fresh globals back
-        (DataPushToGlobalServers* + DataPullFromGlobalServers*)."""
-        with self.profiler.scope(f"RelayToGlobal:{key}", "comm"):
+        (DataPushToGlobalServers* + DataPullFromGlobalServers*).
+        ``round_`` tags the span for cross-party round correlation."""
+        with self.profiler.scope(f"RelayToGlobal:{key}", "comm",
+                                 args={"key": key, "round_id": round_}):
             return self._relay_to_global_impl(key, grad)
 
     def _relay_to_global_impl(self, key: str, grad: np.ndarray) -> np.ndarray:
@@ -903,7 +942,8 @@ class GeoPSServer:
         pulled = c.pull(key, timeout=120.0, meta={"reliable": True})
         return np.asarray(pulled, np.float32).reshape(grad.shape)
 
-    def _relay_row_sparse(self, key: str, rows, vals: np.ndarray):
+    def _relay_row_sparse(self, key: str, rows, vals: np.ndarray,
+                          round_: Optional[int] = None):
         """Push only the touched rows up, pull their fresh values back —
         row-sparse through the dist path (kvstore_dist.h:874-906).
         ``rows`` are unique and sorted, ``vals`` their summed values.
@@ -917,7 +957,8 @@ class GeoPSServer:
             # placement like the dense path, so split keys route correctly
             place = self._placement(key, self._store[key].value.shape)
             self._gplace[key] = place
-        with self.profiler.scope(f"RelayRowSparse:{key}", "comm"):
+        with self.profiler.scope(f"RelayRowSparse:{key}", "comm",
+                                 args={"key": key, "round_id": round_}):
             if place["owner"] >= 0:
                 c = self._gclients[place["owner"]]
                 c.push_row_sparse(key, rows_arr, vals, timeout=120.0)
@@ -993,7 +1034,14 @@ class GeoPSServer:
         return np.asarray(msg.array, np.float32)
 
     def _handle_push(self, conn, msg: Msg):
-        with self.profiler.scope(f"ServerPush:{msg.key}", "kvstore"):
+        self._m_pushes.inc()
+        # round correlation (telemetry/tracing.py): the pusher's per-key
+        # round counter is the cross-party round id — merge_traces
+        # stitches this span to the other parties' by (key, round_id)
+        with self.profiler.scope(f"ServerPush:{msg.key}", "kvstore",
+                                 args={"key": msg.key,
+                                       "round_id": msg.meta.get("round"),
+                                       "sender": msg.sender}):
             self._handle_push_profiled(conn, msg)
 
     def _handle_push_profiled(self, conn, msg: Msg):
@@ -1220,6 +1268,7 @@ class GeoPSServer:
             # stall every other key, pulls and heartbeats for up to the
             # relay timeout — ADVICE r3 #3); the pusher is ACKed after
             # the fresh value installs.
+            rnd = int(msg.meta.get("round", st.round + 1))
             if rs is not None:
                 rows_u, vals_u = self._rs_unique([rs[0]], [rs[1]])
                 if self._gclients:
@@ -1227,14 +1276,15 @@ class GeoPSServer:
                         self._seen_pushes[sig] = "parked"
                     self._relay_enqueue(
                         key,
-                        ((rows_u, vals_u), False, True, (conn, msg, sig)))
+                        ((rows_u, vals_u), False, True, (conn, msg, sig),
+                         rnd))
                     return
                 self._apply_row_sparse(key, rows_u, vals_u)
             elif self._gclients:
                 if sig is not None:
                     self._seen_pushes[sig] = "parked"
                 self._relay_enqueue(
-                    key, (grad, False, False, (conn, msg, sig)))
+                    key, (grad, False, False, (conn, msg, sig), rnd))
                 return
             else:
                 self._apply(key, grad)
@@ -1288,12 +1338,15 @@ class GeoPSServer:
         so worker eviction (resilience/) can close rounds the evicted
         worker would otherwise stall forever."""
         merged, st.merged, st.count = st.merged, None, 0
+        rnd = st.round + 1  # the round this merge completes
+        self.profiler.instant(f"ServerMerge:{key}", "kvstore",
+                              args={"key": key, "round_id": rnd})
         if st.rs_rows:
             rows_u, vals_u = self._rs_unique(st.rs_rows, st.rs_vals)
             st.rs_rows, st.rs_vals = [], []
             if self._gclients:
                 self._relay_enqueue(
-                    key, ((rows_u, vals_u), False, True, None))
+                    key, ((rows_u, vals_u), False, True, None, rnd))
                 return
             self._apply_row_sparse(key, rows_u, vals_u)
             self._finish_round_locked(key, st)
@@ -1320,10 +1373,11 @@ class GeoPSServer:
                     # (ADVICE r2 #3); the round completes on install.
                     delta = (st.value.astype(np.float32) - st.milestone) \
                         / self.num_global_workers
-                    self._relay_enqueue(key, (delta, True, False, None))
+                    self._relay_enqueue(key, (delta, True, False, None,
+                                              rnd))
                     return
             else:
-                self._relay_enqueue(key, (merged, False, False, None))
+                self._relay_enqueue(key, (merged, False, False, None, rnd))
                 return
         else:
             self._apply(key, merged)
@@ -1365,18 +1419,28 @@ class GeoPSServer:
                 if 0 < st.count and st.count >= self.num_workers:
                     self._complete_merge_locked(key, st)
         self.heartbeats.unregister(sender)
+        self._m_evictions.inc()
+        self._m_workers.set(self.num_workers)
+        self.profiler.instant("ServerEvictWorker", "kvstore",
+                              args={"sender": sender,
+                                    "num_workers": self.num_workers})
         return self.num_workers
 
     def _finish_round_locked(self, key: str, st: _KeyState):
         """Complete a sync round: bump the round counter, answer the pulls
         it unblocks, feed the TS distributor.  Caller holds self._lock."""
         st.round += 1
+        self._m_rounds.inc()
         still = []
         for c, req, need in st.waiting_pulls:
             if st.round >= need:
                 rows = req.meta.get("rows")
                 val = st.value if rows is None else \
                     st.value[np.asarray(rows, np.int64)]
+                self.profiler.instant(
+                    f"ServerPull:{key}", "kvstore",
+                    args={"key": key, "round_id": st.round,
+                          "sender": req.sender})
                 try:
                     self._reply_pull_value(c, req, key, val)
                 except OSError:
@@ -1420,15 +1484,21 @@ class GeoPSServer:
             # ``reply_to`` is (conn, request) for an async-mode push whose
             # ACK is deferred until the relayed value installs; None for
             # sync-mode rounds (their ACKs went out at merge time and the
-            # round completes via _finish_round_locked)
-            key, (payload, is_milestone, is_rs, reply_to) = item
+            # round completes via _finish_round_locked).  ``round_`` is
+            # the WAN round id the relay belongs to (telemetry/tracing).
+            key, (payload, is_milestone, is_rs, reply_to, round_) = item
+            t_relay = time.perf_counter()
             try:
                 if is_rs:
                     rs_rows, rs_vals = payload
-                    fresh = self._relay_row_sparse(key, rs_rows, rs_vals)
+                    fresh = self._relay_row_sparse(key, rs_rows, rs_vals,
+                                                   round_=round_)
                 else:
-                    fresh = self._relay_to_global(key, payload)
+                    fresh = self._relay_to_global(key, payload,
+                                                  round_=round_)
+                self._m_relay_s.observe(time.perf_counter() - t_relay)
             except Exception as e:
+                self._m_relay_fail.inc()
                 # the round can never complete: fail current waiters fast
                 # with the reason, latch the error so pulls that arrive
                 # AFTER the failure (the common case — the network round
@@ -1539,6 +1609,7 @@ class GeoPSServer:
             sched.report(0, r, value.nbytes / dt, version)
 
     def _handle_pull(self, conn, msg: Msg):
+        self._m_pulls.inc()
         with self._lock:
             st = self._store.get(msg.key)
             if st is None:
@@ -1572,6 +1643,10 @@ class GeoPSServer:
             rows = msg.meta.get("rows")
             val = st.value if rows is None else \
                 st.value[np.asarray(rows, np.int64)]
+            self.profiler.instant(
+                f"ServerPull:{msg.key}", "kvstore",
+                args={"key": msg.key, "round_id": st.round,
+                      "sender": msg.sender})
             self._reply_pull_value(conn, msg, msg.key, val)
 
     def _reply_pull_value(self, conn, req: Msg, key: str, val):

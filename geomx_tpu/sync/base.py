@@ -36,6 +36,15 @@ class SyncAlgorithm(abc.ABC):
     num_parties: int = 1
     workers_per_party: int = 1
 
+    # telemetry (telemetry/probes.py): True when sync_grads returns a
+    # gradient REPLICATED across the mesh (hierarchical aggregation:
+    # FSA/MixedSync/PipelinedSync).  Algorithms keeping per-device
+    # gradients (HFA's identity sync_grads — workers update locally)
+    # leave it False, so the replicated-value probes (grad norm,
+    # aggregate density) are skipped instead of silently publishing one
+    # shard's local value under a replicated out-spec.
+    grads_replicated_after_sync: bool = False
+
     # degraded-mode membership (resilience/): None = every party live.
     # Set only via bind_membership; algorithms opt in with
     # supports_degraded (the mask changes the dc-tier algebra, and an
@@ -134,3 +143,34 @@ class SyncAlgorithm(abc.ABC):
         pmean) are expressible; stateless algorithms return ``state``
         unchanged."""
         return model_state, state
+
+    # ---- telemetry (telemetry/probes.py) -----------------------------------
+
+    def telemetry_scalars(self, state: Any) -> dict:
+        """In-graph health scalars from this algorithm's sync state
+        (party-LOCAL values; the probe layer folds them to the party
+        mean).  Called inside the traced step ONLY when telemetry is
+        enabled, so implementations are free to add reductions — the
+        disabled path never sees them.  Base: nothing to report."""
+        return {}
+
+    def wire_accounting(self, params: Any) -> dict:
+        """Static per-step wire-volume accounting (plain Python floats,
+        resolved at trace/build time): what each tier puts on the wire
+        per step, and the achieved compression ratio vs the dense fp32
+        payload.  Algorithms with a dc-tier compressor get the generic
+        accounting for free."""
+        out = {}
+        dc = getattr(self, "dc_compressor", None)
+        if dc is not None:
+            leaves = jax.tree.leaves(params)
+            dense = float(sum(
+                l.size * np.dtype(l.dtype).itemsize for l in leaves))
+            wire = float(dc.wire_bytes(params))
+            out["dc_wire_bytes"] = wire
+            out["dc_dense_bytes"] = dense
+            out["dc_compression_ratio"] = dense / wire if wire else 1.0
+        wc = getattr(self, "worker_compressor", None)
+        if wc is not None:
+            out["worker_wire_bytes"] = float(wc.wire_bytes(params))
+        return out

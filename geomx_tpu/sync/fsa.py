@@ -37,6 +37,7 @@ from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
 class FSA(SyncAlgorithm):
     name = "fsa"
     supports_degraded = True  # renormalized survivor mean (resilience/)
+    grads_replicated_after_sync = True  # hierarchical psum output
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
                  worker_compressor: Optional[Compressor] = None,
@@ -108,3 +109,11 @@ class FSA(SyncAlgorithm):
         if policy == "carry":
             return state
         return dict(state, dc_comp=self.dc_compressor.init_state(params))
+
+    def telemetry_scalars(self, state: Any) -> dict:
+        """EF-residual magnitude of the dc-tier compressor state (the
+        momentum/velocity buffers a sparse compressor holds back): the
+        in-situ "how much gradient mass is parked in error feedback"
+        signal (telemetry/probes.py; enabled-path only)."""
+        from geomx_tpu.telemetry.probes import tree_norm
+        return {"ef_residual_norm": tree_norm(state["dc_comp"])}

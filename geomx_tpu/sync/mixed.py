@@ -38,6 +38,7 @@ from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
 class MixedSync(SyncAlgorithm):
     name = "mixed"
     supports_degraded = True  # renormalized survivor mean (resilience/)
+    grads_replicated_after_sync = True  # hierarchical psum output
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
                  pull_interval: int = 1, dcasgd_lambda: float = 0.0,
@@ -120,3 +121,13 @@ class MixedSync(SyncAlgorithm):
         if policy == "carry":
             return state
         return dict(state, dc_comp=self.dc_compressor.init_state(params))
+
+    def telemetry_scalars(self, state: Any) -> dict:
+        """EF residual magnitude plus the staleness gap: the distance
+        between the true weights' last refresh and the stale copy the
+        party trains at is exactly the drift DCASGD compensates —
+        watching it catch a mis-set pull_interval in situ
+        (telemetry/probes.py; enabled-path only)."""
+        from geomx_tpu.telemetry.probes import tree_norm
+        return {"ef_residual_norm": tree_norm(state["dc_comp"]),
+                "stale_copy_norm": tree_norm(state["stale"])}

@@ -215,6 +215,9 @@ class PipelinedSync(SyncAlgorithm):
     """
 
     supports_degraded = True  # delegates the masked mean to FSA/MixedSync
+    # the applied gradient is the previous step's completed dc aggregate
+    # (plus a correction from replicated params) — replicated
+    grads_replicated_after_sync = True
 
     def __init__(self, inner: SyncAlgorithm, depth: Optional[int] = None,
                  dcasgd_lambda: float = 0.0):
@@ -376,6 +379,36 @@ class PipelinedSync(SyncAlgorithm):
                 lambda gg, w, wp: gg + lam * gg * gg * (w - wp),
                 g, params, state["prev_params"])
         return g, new_state
+
+    # -- telemetry (telemetry/probes.py; enabled-path only) ------------------
+    def telemetry_scalars(self, state: Any) -> dict:
+        """Pipeline-aware health scalars: the wrapped algorithm's EF
+        residual (from the pipelined compressor's inner state, not the
+        double-buffer) plus the in-flight aggregate's magnitude — a
+        persistently-zero inflight norm after warmup means the pipeline
+        is applying empty aggregates (exactly the silent failure a
+        staleness bug produces)."""
+        from geomx_tpu.telemetry.probes import tree_norm
+        inner_state = state["inner"]
+        dc = inner_state.get("dc_comp") if isinstance(inner_state, dict) \
+            else None
+        out = {}
+        if isinstance(dc, dict) and "inflight" in dc:
+            out["pipeline_inflight_norm"] = tree_norm(dc["inflight"])
+            out["ef_residual_norm"] = tree_norm(dc.get("inner"))
+        else:
+            out["ef_residual_norm"] = tree_norm(dc)
+        return out
+
+    def wire_accounting(self, params: Any) -> dict:
+        """The wrapped algorithm's accounting (bytes per step are
+        identical — one step shifted) plus the pipeline's static shape:
+        staleness and the bytes parked in flight between launch and
+        apply."""
+        out = self.inner.wire_accounting(params)
+        out["pipeline_staleness"] = 1.0
+        out["pipeline_inflight_bytes"] = out.get("dc_wire_bytes", 0.0)
+        return out
 
     def drain_model_state(self, model_state: Any,
                           state: Any) -> Tuple[Any, Any]:

@@ -1,0 +1,28 @@
+"""Unified telemetry plane (docs/telemetry.md).
+
+- :mod:`registry` — process-global Counter/Gauge/Histogram registry
+  every subsystem writes into;
+- :mod:`probes` — in-graph step-health probes (grad norm, NaN/Inf,
+  achieved compression, EF residuals), gated by ``GEOMX_TELEMETRY``
+  with a jaxpr-identical disabled path;
+- :mod:`tracing` — cross-party WAN round correlation (``round_id``
+  spans + :func:`merge_traces`);
+- :mod:`export` — Prometheus text exposition and the bounded JSONL
+  event log.
+"""
+
+from geomx_tpu.telemetry.registry import (MetricRegistry, get_registry,
+                                          reset_registry)
+from geomx_tpu.telemetry.probes import telemetry_enabled
+from geomx_tpu.telemetry.export import (EventLog, get_event_log, log_event,
+                                        parse_prometheus_text,
+                                        render_prometheus)
+from geomx_tpu.telemetry.tracing import merge_traces, rounds_in_trace
+
+__all__ = [
+    "MetricRegistry", "get_registry", "reset_registry",
+    "telemetry_enabled",
+    "EventLog", "get_event_log", "log_event",
+    "render_prometheus", "parse_prometheus_text",
+    "merge_traces", "rounds_in_trace",
+]

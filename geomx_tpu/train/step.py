@@ -11,6 +11,7 @@ engine threads for comes from the scheduler here).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable
 
 import jax
@@ -21,6 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from geomx_tpu.parallel.collectives import shard_map_compat
 from geomx_tpu.sync.base import SyncAlgorithm
+from geomx_tpu.telemetry import probes as _probes
 from geomx_tpu.topology import DC_AXIS, SP_AXIS, WORKER_AXIS, HiPSTopology
 from geomx_tpu.train.state import TrainState, state_specs
 
@@ -90,6 +92,12 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     sync.bind_topology(topology)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     sp = getattr(topology, "sp_degree", 1) if sp_model else 1
+    # in-graph telemetry probes (telemetry/probes.py): the gate is
+    # STATIC — resolved here, at build time — and guards the single
+    # probe call site below, so the disabled path traces a jaxpr
+    # byte-identical to a build with telemetry excised (pinned by
+    # tests/test_telemetry.py)
+    telem = _probes.telemetry_enabled(config)
 
     mgps = None
     if config is not None and getattr(config, "multi_gps", False):
@@ -253,16 +261,33 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                 if jnp.issubdtype(a.dtype, jnp.floating) else a,
                 model_state)
 
-        if mgps is not None:
-            params, opt_state, sync_state = _mgps_sync_update(
-                grads, params, opt_state, sync_state, step)
-        else:
-            grads, sync_state = sync.sync_grads(grads, params, sync_state, step)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            params, sync_state = sync.sync_params(params, sync_state, step)
-        model_state, sync_state = sync.sync_model_state(model_state,
-                                                        sync_state, step)
+        # kept for the probes: this device's gradients before any
+        # cross-party aggregation (pure aliases — no traced ops)
+        raw_grads = grads
+        synced_grads = None
+        probe_ctx = _probes.inline_collection() if telem \
+            else contextlib.nullcontext(None)
+        with probe_ctx as inline_sink:
+            if mgps is not None:
+                params, opt_state, sync_state = _mgps_sync_update(
+                    grads, params, opt_state, sync_state, step)
+            else:
+                grads, sync_state = sync.sync_grads(grads, params,
+                                                    sync_state, step)
+                # only algorithms whose sync output is mesh-replicated
+                # feed the replicated-value probes (HFA's identity
+                # sync_grads keeps per-device gradients, and publishing
+                # one shard's local value under a replicated out-spec
+                # would silently misreport)
+                if sync.grads_replicated_after_sync:
+                    synced_grads = grads
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                params, sync_state = sync.sync_params(params, sync_state,
+                                                      step)
+            model_state, sync_state = sync.sync_model_state(model_state,
+                                                            sync_state,
+                                                            step)
 
         acc = jnp.mean(jnp.argmax(logits, -1) == yb)
         metrics = {"loss": loss, "accuracy": acc}
@@ -286,6 +311,14 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         # degraded steps really ran the renormalized survivor mean
         metrics["num_live_parties"] = jnp.asarray(sync.num_live,
                                                   jnp.float32)
+        if telem:
+            # step-health probes ride the replicated metrics output
+            # (every value is mesh-replicated by construction); the host
+            # plane (Trainer fit loop) publishes them to the metric
+            # registry and the event log
+            metrics["telemetry"] = _probes.collect_step_probes(
+                raw_grads, synced_grads, sync, sync_state, inline_sink,
+                params)
 
         new_state = TrainState(
             step=step + 1,

@@ -84,6 +84,23 @@ class Trainer:
         self._epoch_runners: dict = {}
         self._eval_cache: dict = {}    # device-resident test set
         self._eval_sweeps: dict = {}   # batch_size -> scanned eval program
+        # telemetry plane (docs/telemetry.md): when enabled, the fit
+        # loop publishes the in-graph step probes to the metric registry
+        # and the event log at the same boundaries it already syncs for
+        # logging (no extra device round trips)
+        from geomx_tpu.telemetry.probes import telemetry_enabled
+        self._telemetry = telemetry_enabled(self.config)
+        self._telem_last_it = 0
+        self._event_log = None
+        events_path = getattr(self.config, "telemetry_events", "")
+        if events_path:
+            from geomx_tpu.telemetry.export import (EventLog,
+                                                    set_default_event_log)
+            self._event_log = EventLog(events_path)
+            # make this the process default too, so subsystems that only
+            # know the global log_event() (membership transitions, relay
+            # failures) land in the SAME file as the step probes
+            set_default_event_log(self._event_log)
 
     def init_state(self, rng: jax.Array, sample_input: np.ndarray) -> TrainState:
         """sample_input: one local batch [b, H, W, C] (uint8 images) or
@@ -309,6 +326,67 @@ class Trainer:
                 _drain, self.mesh, in_specs=(specs,), out_specs=specs))
         return self._drain_step(state)
 
+    def _publish_telemetry(self, telem: dict, iteration: int,
+                           stacked: bool = False) -> None:
+        """Publish one step's probe dict (already device_get) to the
+        metric registry + event log.  ``stacked=True``: the values carry
+        a leading scan dimension (epoch runner) — publish the last step.
+        Scalars become ``geomx_step_probe{probe=...}`` gauges, per-party
+        vectors ``geomx_step_probe_party{probe=...,party=...}``; the
+        static wire accounting also feeds monotonic byte/step counters
+        (delta-scaled by the steps since the last publish, so counter
+        rates stay honest at any log_every)."""
+        from geomx_tpu.telemetry import get_registry, log_event
+        reg = get_registry()
+        fam = reg.gauge("geomx_step_probe",
+                        "Latest published in-graph step probe", ("probe",))
+        fam_p = reg.gauge("geomx_step_probe_party",
+                          "Latest per-party in-graph step probe",
+                          ("probe", "party"))
+        flat: dict = {}
+        for name, val in telem.items():
+            arr = np.asarray(val)
+            if stacked and arr.ndim >= 1:
+                arr = arr[-1]
+            if arr.ndim == 0:
+                flat[name] = float(arr)
+                fam.labels(probe=name).set(float(arr))
+            elif arr.ndim == 1:
+                flat[name] = [float(v) for v in arr]
+                for p, v in enumerate(arr):
+                    fam_p.labels(probe=name, party=str(p)).set(float(v))
+        steps = iteration - self._telem_last_it
+        if steps > 0:
+            reg.counter("geomx_train_steps_total",
+                        "Training steps published").inc(steps)
+            if "dc_wire_bytes" in flat:
+                reg.counter(
+                    "geomx_dc_wire_bytes_total",
+                    "dc-tier bytes put on the wire per party"
+                ).inc(flat["dc_wire_bytes"] * steps)
+            self._telem_last_it = iteration
+        dc = getattr(self.sync, "dc_compressor", None)
+        if dc is None:  # PipelinedSync wraps the algorithm that has it
+            dc = getattr(getattr(self.sync, "inner", None),
+                         "dc_compressor", None)
+        while dc is not None and not hasattr(dc, "layout_summary") \
+                and hasattr(dc, "inner"):
+            dc = dc.inner  # unwrap Pipelined/DGT wrappers to the bucketer
+        layout = getattr(dc, "layout_summary", None)
+        layout = layout() if callable(layout) else None
+        if layout:
+            reg.gauge("geomx_bucket_count",
+                      "dc-tier fused buckets per step").set(
+                layout["num_buckets"])
+            reg.gauge("geomx_bucket_pad_fraction",
+                      "Lane-padding waste in the bucket layout").set(
+                layout["pad_fraction"])
+        if self._event_log is not None:
+            self._event_log.emit("step_probes", iteration=iteration,
+                                 **flat)
+        else:
+            log_event("step_probes", iteration=iteration, **flat)
+
     def predict_logits(self, state: TrainState, x: np.ndarray,
                        batch_size: int = 512) -> np.ndarray:
         """Jitted logits over a host array (one device, unreplicated
@@ -454,6 +532,10 @@ class Trainer:
         """
         measure = measure if measure is not None else Measure()
         measure.reset_clock()
+        # iteration numbering restarts per fit, so the telemetry delta
+        # base must too — a stale high-water mark from a previous fit
+        # would silently swallow this fit's step/byte counter increments
+        self._telem_last_it = 0
         if scan_epochs:
             if not getattr(loader, "device_cache", False):
                 raise ValueError("scan_epochs requires device_cache=True "
@@ -471,6 +553,18 @@ class Trainer:
                     fields.update(
                         loss=float(np.mean(ms["loss"])),
                         train_acc=float(np.mean(ms["accuracy"])))
+                    if self._telemetry and "telemetry" in ms:
+                        # scanned epoch: probe values carry a leading
+                        # step dimension; publish the last step's
+                        self._publish_telemetry(ms["telemetry"], it,
+                                                stacked=True)
+                elif self._telemetry:
+                    # log_every=0: still publish the epoch's last step
+                    # (same fallback the non-scanned loop has)
+                    ms = jax.device_get(ms)
+                    if "telemetry" in ms:
+                        self._publish_telemetry(ms["telemetry"], it,
+                                                stacked=True)
                 if eval_data is not None:
                     fields["test_acc"] = self.evaluate(state, *eval_data)
                 if fields:
@@ -496,6 +590,8 @@ class Trainer:
                     metrics = jax.device_get(metrics)
                     fields.update(loss=float(metrics["loss"]),
                                   train_acc=float(metrics["accuracy"]))
+                    if self._telemetry and "telemetry" in metrics:
+                        self._publish_telemetry(metrics["telemetry"], it)
                 elif it % sync_every == 0:
                     jax.block_until_ready(metrics["loss"])
                 if eval_data is not None and eval_every and it % eval_every == 0:
@@ -503,6 +599,13 @@ class Trainer:
                 if fields:
                     rec = measure.add(epoch=epoch, iteration=it, **fields)
                     log_fn(json.dumps(rec))
+            if self._telemetry and not log_every and it:
+                # no log boundary ever synced this epoch: publish the
+                # epoch's last step so the registry/event log still track
+                # a log_every=0 run (one device_get per epoch)
+                last = jax.device_get(metrics)
+                if "telemetry" in last:
+                    self._publish_telemetry(last["telemetry"], it)
             if eval_data is not None and not eval_every:
                 rec = measure.add(epoch=epoch, iteration=it,
                                   test_acc=self.evaluate(state, *eval_data))

@@ -1,0 +1,42 @@
+"""Shared file-writing primitives for the observability outputs."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+# the process umask, probed ONCE at import (set+restore is not
+# thread-safe, and server handler threads / the profiler / the trainer
+# dump concurrently; imports run before those threads exist).  A
+# process that later changes its umask keeps the import-time mode for
+# these dumps — acceptable for observability artifacts.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+def atomic_json_dump(path: str, obj, **json_kwargs) -> str:
+    """Write ``obj`` as JSON to ``path`` ATOMICALLY: serialize to a temp
+    file in the destination directory and ``os.replace`` it into place,
+    so a crash (or a concurrent reader) mid-dump can never observe a
+    truncated, unloadable file.  The final file keeps umask-honoring
+    permissions like a plain ``open(path, "w")`` would (mkstemp creates
+    0600, which would otherwise survive the replace and lock out e.g. a
+    group-shared artifact collector)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d or ".", prefix=".atomic_",
+                               suffix=".tmp")
+    try:
+        os.fchmod(fd, 0o666 & ~_UMASK)
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, **json_kwargs)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -19,7 +19,6 @@ COMMAND {cmd: "set_profiler_params"|"profiler_start"|"profiler_stop"|
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import threading
 import time
@@ -60,7 +59,17 @@ class Profiler:
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        # wall-clock anchor of the trace's t=0: merge_traces
+        # (telemetry/tracing.py) aligns per-process monotonic clocks on
+        # it, so N parties' dumps land on one real timeline
+        self._anchor_unix_us = time.time() * 1e6
         self._device_trace_dir: Optional[str] = None
+        # stable registry-assigned trace lane per thread:
+        # threading.get_ident() % 100000 could alias two threads into one
+        # lane, so the first event from a thread claims the next small id
+        # and the thread's name becomes lane metadata at dump time
+        self._tid_ids: Dict[int, int] = {}
+        self._tid_names: Dict[int, str] = {}
 
     # ---- configuration (reference kSetProfilerParams payload) -------------
     def set_config(self, filename: Optional[str] = None,
@@ -78,6 +87,16 @@ class Profiler:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def _tid_locked(self) -> int:
+        """Stable small trace-lane id for the calling thread (caller
+        holds self._lock)."""
+        ident = threading.get_ident()
+        tid = self._tid_ids.get(ident)
+        if tid is None:
+            tid = self._tid_ids[ident] = len(self._tid_ids)
+            self._tid_names[tid] = threading.current_thread().name
+        return tid
+
     def add_event(self, name: str, begin_us: float, end_us: float,
                   category: str = "host", args: Optional[Dict] = None):
         if not self.running:
@@ -86,19 +105,23 @@ class Profiler:
             self._events.append({
                 "name": name, "cat": category, "ph": "X",
                 "ts": begin_us, "dur": end_us - begin_us,
-                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+                "pid": os.getpid(), "tid": self._tid_locked(),
                 "args": args or {},
             })
 
-    def instant(self, name: str, category: str = "host"):
+    def instant(self, name: str, category: str = "host",
+                args: Optional[Dict] = None):
         if not self.running:
             return
         with self._lock:
-            self._events.append({
+            ev = {
                 "name": name, "cat": category, "ph": "i", "s": "g",
                 "ts": self._now_us(), "pid": os.getpid(),
-                "tid": threading.get_ident() % 100000,
-            })
+                "tid": self._tid_locked(),
+            }
+            if args:
+                ev["args"] = dict(args)
+            self._events.append(ev)
 
     def counter(self, name: str, values: Dict[str, float],
                 category: str = "host"):
@@ -171,15 +194,25 @@ class Profiler:
         return os.path.join(d, f"rank{self.rank}_{b}")
 
     def dump(self, path: Optional[str] = None) -> str:
+        """Write the Chrome trace ATOMICALLY: serialize to a temp file in
+        the destination directory and ``os.replace`` it into place, so a
+        crash (or a concurrent reader) mid-dump can never observe a
+        truncated, unloadable trace.  Thread-name metadata rows label
+        each registry-assigned lane; ``metadata.anchor_unix_us`` is the
+        wall-clock anchor ``merge_traces`` aligns cross-party dumps on."""
         path = path or self._dump_path()
         with self._lock:
             events = list(self._events)
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-        return path
+            names = dict(self._tid_names)
+        pid = os.getpid()
+        for tid, tname in sorted(names.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "metadata": {"anchor_unix_us": self._anchor_unix_us,
+                            "rank": self.rank}}
+        from geomx_tpu.utils.fileio import atomic_json_dump
+        return atomic_json_dump(path, doc)
 
     def aggregate_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-name {count,total_us,min_us,max_us,avg_us} — the reference's

@@ -174,3 +174,41 @@ def test_resume_clears_error_only_when_all_units_good():
     # a failed resumable phase (e.g. tta) also blocks the clear
     results["tta"] = {"error": "died"}
     assert not bench._resume_clears_error(results, True, None)
+
+
+def test_watchdog_publishes_stacks_and_init_phases(tmp_path):
+    """Watchdog diagnosability (BENCH_r05 recorded only "backend init
+    exceeded 480s" twice, with zero clue where it hung): when the init
+    watchdog fires, the published record must carry the per-phase init
+    timestamps and the child's all-thread faulthandler stack dump."""
+    env = dict(os.environ)
+    env.update({
+        "GEOMX_BENCH_PLATFORM": "cpu",
+        "GEOMX_BENCH_INIT_TIMEOUT": "5",
+        "GEOMX_BENCH_INIT_ATTEMPTS": "1",
+        "GEOMX_BENCH_CPU_FALLBACK": "0",
+        "GEOMX_BENCH_RESUME_ATTEMPTS": "0",
+        # the hook wedges the child right after its first phase mark,
+        # before the jax import, so the whole test bounds at ~10s
+        "GEOMX_BENCH_FAULT_HANG_INIT": "120",
+    })
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")], cwd=REPO,
+        env=env, capture_output=True, text=True, timeout=90)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, out.stderr[-2000:]
+    rec = json.loads(lines[-1])
+    assert "watchdog" in rec, rec.get("error")
+    wd = rec["watchdog"]
+    assert wd["phase"] == "backend init"
+    # the child got as far as its first phase mark — and no further
+    assert "child_start" in wd["init_phases"]
+    assert "jax_imported" not in wd["init_phases"]
+    assert rec["init_phases"]["child_start"] is not None
+    # the SIGUSR1 faulthandler dump reached the record: real stack
+    # lines naming the wedged frame
+    stacks = "\n".join(wd["stacks"])
+    assert "Thread" in stacks or "File" in stacks, stacks[:500]
+    assert "time.sleep" in stacks or "bench" in stacks, stacks[:500]
+    assert "last init phase: child_start" in rec["error"]

@@ -27,13 +27,15 @@ def test_scope_recording_and_chrome_dump(tmp_path):
     path = p.dump()
     with open(path) as f:
         doc = json.load(f)
-    names = [e["name"] for e in doc["traceEvents"]]
+    # duration spans plus the thread-name lane metadata rows (ph "M")
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = [e["name"] for e in spans]
     assert set(names) == {"step", "fwd", "bwd"}
-    # complete events with microsecond durations
-    by = {e["name"]: e for e in doc["traceEvents"]}
-    assert all(e["ph"] == "X" for e in doc["traceEvents"])
+    by = {e["name"]: e for e in spans}
     assert by["fwd"]["dur"] >= 1000  # slept 2ms
     assert by["step"]["dur"] >= by["fwd"]["dur"] + by["bwd"]["dur"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "thread_name" for e in meta)
 
 
 def test_disabled_profiler_records_nothing(tmp_path):

@@ -1,0 +1,593 @@
+"""Unified telemetry plane (geomx_tpu/telemetry/, docs/telemetry.md).
+
+The contracts under test:
+
+- registry: thread-safe Counter/Gauge/Histogram families with label
+  sets; schema conflicts fail loudly; concurrent writers never lose
+  increments;
+- export: the Prometheus text exposition round-trips through the strict
+  minimal parser (types, labels, escaping, cumulative histograms), both
+  over the scheduler's HTTP endpoint and the PS wire protocol;
+- probes: with GEOMX_TELEMETRY off the traced step jaxpr is
+  byte-identical to a probe-excised build (THE overhead guarantee);
+  enabled, the step reports grad health / compression / EF-residual
+  scalars;
+- tracing: a 2-party in-process WAN run merges into one Chrome trace
+  where every round's push/merge/pull spans share a round_id, and
+  skewed party clocks are realigned on the dump anchors.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from geomx_tpu.config import GeoConfig
+from geomx_tpu.models import MLP
+from geomx_tpu.service import GeoPSClient, GeoPSServer
+from geomx_tpu.service.scheduler import GeoScheduler, SchedulerClient
+from geomx_tpu.sync import get_sync_algorithm
+from geomx_tpu.telemetry import (EventLog, get_registry, merge_traces,
+                                 parse_prometheus_text, render_prometheus,
+                                 rounds_in_trace)
+from geomx_tpu.telemetry import probes as probes_mod
+from geomx_tpu.telemetry.probes import canonicalize_jaxpr
+from geomx_tpu.telemetry.registry import MetricRegistry
+from geomx_tpu.topology import HiPSTopology
+from geomx_tpu.train import Trainer
+from geomx_tpu.utils.metrics import Measure
+from geomx_tpu.utils.profiler import Profiler
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricRegistry()
+    c = reg.counter("t_requests_total", "requests", ("route",))
+    c.labels(route="/a").inc()
+    c.labels(route="/a").inc(2)
+    c.labels("/b").inc()
+    assert c.labels(route="/a").value == 3
+    assert c.labels(route="/b").value == 1
+    with pytest.raises(ValueError):
+        c.labels(route="/a").inc(-1)  # counters only go up
+
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(5)
+    g.dec()
+    assert g._solo().value == 4
+
+    h = reg.histogram("t_lat", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    cum, total, count = h._solo().snapshot()
+    assert cum == [1, 3, 4] and count == 4
+    assert abs(total - 6.05) < 1e-9
+
+
+def test_registry_idempotent_and_schema_conflicts():
+    reg = MetricRegistry()
+    a = reg.counter("t_x_total", "x", ("k",))
+    b = reg.counter("t_x_total", "x", ("k",))
+    assert a is b  # idempotent re-registration
+    with pytest.raises(ValueError, match="different schema"):
+        reg.gauge("t_x_total", "x", ("k",))
+    with pytest.raises(ValueError, match="different schema"):
+        reg.counter("t_x_total", "x", ("other",))
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad-name", "x")
+    with pytest.raises(ValueError):
+        a.labels(wrong="v")
+    # histogram buckets are part of the schema: silently mixing units
+    # into the first registrant's boundaries would wreck the quantiles
+    reg.histogram("t_h", "h", buckets=(1.0, 2.0))
+    assert reg.histogram("t_h", "h", buckets=(2.0, 1.0)) is not None
+    with pytest.raises(ValueError, match="different schema"):
+        reg.histogram("t_h", "h", buckets=(5.0, 10.0))
+
+
+def test_registry_concurrent_increments_lose_nothing():
+    reg = MetricRegistry()
+    c = reg.counter("t_conc_total", "", ("t",))
+    h = reg.histogram("t_conc_lat", "")
+    per_thread, n_threads = 500, 8
+
+    def work(i):
+        child = c.labels(t=str(i % 2))
+        for _ in range(per_thread):
+            child.inc()
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(c.labels(t=str(k)).value for k in (0, 1))
+    assert total == per_thread * n_threads
+    assert h._solo().count == per_thread * n_threads
+
+
+# --------------------------------------------------------------------------
+# export: exposition format round trip
+# --------------------------------------------------------------------------
+
+def test_prometheus_render_parse_roundtrip():
+    reg = MetricRegistry()
+    c = reg.counter("t_rt_total", "with \"quotes\" and \\slashes",
+                    ("name",))
+    c.labels(name='va"l\\ue\n2').inc(7)
+    reg.gauge("t_rt_gauge", "a gauge").set(-1.5)
+    h = reg.histogram("t_rt_hist", "hist", ("op",), buckets=(1.0, 2.0))
+    h.labels(op="push").observe(0.5)
+    h.labels(op="push").observe(10.0)
+
+    text = render_prometheus(reg)
+    fams = parse_prometheus_text(text)
+    assert fams["t_rt_total"]["type"] == "counter"
+    (sname, labels, value), = fams["t_rt_total"]["samples"]
+    assert labels == {"name": 'va"l\\ue\n2'} and value == 7
+    assert fams["t_rt_gauge"]["samples"][0][2] == -1.5
+    hs = {(s, labels.get("le")): v
+          for s, labels, v in fams["t_rt_hist"]["samples"]}
+    assert hs[("t_rt_hist_bucket", "1")] == 1
+    assert hs[("t_rt_hist_bucket", "+Inf")] == 2
+    assert hs[("t_rt_hist_count", None)] == 2
+    assert abs(hs[("t_rt_hist_sum", None)] - 10.5) < 1e-9
+
+
+def test_parser_rejects_untyped_and_noncumulative():
+    with pytest.raises(ValueError, match="no TYPE"):
+        parse_prometheus_text("mystery_metric 1\n")
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+           "h_sum 1\nh_count 3\n")
+    with pytest.raises(ValueError, match="non-cumulative"):
+        parse_prometheus_text(bad)
+
+
+# --------------------------------------------------------------------------
+# export: bounded JSONL event log
+# --------------------------------------------------------------------------
+
+def test_event_log_bounded_rotation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, max_bytes=2048)
+    for i in range(200):
+        log.emit("tick", i=i, pad="x" * 64)
+    import os
+    assert os.path.getsize(path) <= 2048
+    assert os.path.exists(path + ".1")  # exactly one rotated generation
+    events = log.read()
+    assert all("ts" in e and "kind" in e for e in events)
+    # the rotation start is marked, so a reader knows history was shed
+    assert events[0]["kind"] == "rotated"
+    assert events[-1]["i"] == 199
+
+
+# --------------------------------------------------------------------------
+# scheduler + PS server export surfaces
+# --------------------------------------------------------------------------
+
+def test_scheduler_serves_live_prometheus_http_and_command():
+    sched = GeoScheduler(metrics_port=0).start()
+    try:
+        c = SchedulerClient(("127.0.0.1", sched.port))
+        c.register("worker", tag="0.0")
+        c.heartbeat()
+        # HTTP scrape
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{sched.metrics_port}/metrics",
+                timeout=10) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            text = resp.read().decode()
+        fams = parse_prometheus_text(text)
+        # live Counter, Gauge AND Histogram series (acceptance criterion)
+        assert fams["geomx_scheduler_registrations_total"]["type"] == \
+            "counter"
+        reg_sample = [s for s in
+                      fams["geomx_scheduler_registrations_total"]["samples"]
+                      if s[1].get("role") == "worker"]
+        assert reg_sample and reg_sample[0][2] >= 1
+        assert fams["geomx_scheduler_roster_epoch"]["type"] == "gauge"
+        assert fams["geomx_scheduler_roster_epoch"]["samples"][0][2] >= 1
+        assert fams["geomx_scheduler_request_seconds"]["type"] == \
+            "histogram"
+        counts = [v for s, labels, v in
+                  fams["geomx_scheduler_request_seconds"]["samples"]
+                  if s.endswith("_count")]
+        assert counts and counts[0] >= 1
+        # the COMMAND twin serves the same exposition over the wire
+        fams2 = parse_prometheus_text(c.metrics_text())
+        assert "geomx_scheduler_roster_epoch" in fams2
+        # 404 for anything else
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{sched.metrics_port}/nope", timeout=10)
+        c.close()
+    finally:
+        sched.stop()
+
+
+def test_ps_server_metrics_command():
+    server = GeoPSServer(num_workers=1, mode="sync", rank=7).start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0)
+    try:
+        c.init("w", np.zeros(32, np.float32))
+        c.push("w", np.ones(32, np.float32))
+        c.pull("w")
+        fams = parse_prometheus_text(c.metrics_text())
+        pushes = {tuple(sorted(s[1].items())): s[2]
+                  for s in fams["geomx_server_pushes_total"]["samples"]}
+        assert pushes[(("rank", "7"),)] >= 1
+        rounds = {tuple(sorted(s[1].items())): s[2]
+                  for s in fams["geomx_server_rounds_total"]["samples"]}
+        assert rounds[(("rank", "7"),)] >= 1
+        workers = {tuple(sorted(s[1].items())): s[2]
+                   for s in fams["geomx_server_num_workers"]["samples"]}
+        assert workers[(("rank", "7"),)] == 1
+    finally:
+        c.stop_server()
+        c.close()
+        server.join(5)
+
+
+def test_membership_transitions_feed_gauges(tmp_path):
+    from geomx_tpu.resilience import PartyLivenessController
+    from geomx_tpu.telemetry.export import set_default_event_log
+    # a config-installed default event log must catch global log_event
+    # emissions (membership transitions) too, not just the env path
+    log = EventLog(str(tmp_path / "memb.jsonl"))
+    set_default_event_log(log)
+    try:
+        c = PartyLivenessController(num_parties=3)
+        c.mark_dead(1)
+        kinds = [e["kind"] for e in log.read()]
+        assert "membership_epoch" in kinds
+    finally:
+        set_default_event_log(None)
+    reg = get_registry()
+    assert reg.get("geomx_live_parties")._solo().value == 2
+    assert reg.get("geomx_membership_version")._solo().value >= 1
+    assert reg.get("geomx_party_live").labels(party="1").value == 0.0
+    c.mark_live(1)
+    assert reg.get("geomx_live_parties")._solo().value == 3
+    assert reg.get("geomx_party_live").labels(party="1").value == 1.0
+
+
+# --------------------------------------------------------------------------
+# profiler satellites: stable lanes, atomic dumps, concurrency
+# --------------------------------------------------------------------------
+
+def test_profiler_stable_thread_lanes_and_names(tmp_path):
+    p = Profiler(filename=str(tmp_path / "t.json"))
+    p.set_state(True)
+    with p.scope("main-op"):
+        pass
+
+    def other():
+        with p.scope("other-op"):
+            pass
+
+    t = threading.Thread(target=other, name="relay-shard-3")
+    t.start()
+    t.join()
+    doc = json.load(open(p.dump()))
+    spans = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    # registry-assigned small ids, distinct per thread
+    assert spans["main-op"]["tid"] != spans["other-op"]["tid"]
+    assert {spans["main-op"]["tid"], spans["other-op"]["tid"]} == {0, 1}
+    meta = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert meta[spans["other-op"]["tid"]] == "relay-shard-3"
+    # wall-clock anchor for cross-party merge alignment
+    assert doc["metadata"]["anchor_unix_us"] > 0
+
+
+def test_profiler_concurrent_scope_dump_stress(tmp_path):
+    """Writers recording scopes while a reader dumps repeatedly: every
+    dump must be complete, parseable JSON (atomic temp+replace), and no
+    event may be torn."""
+    p = Profiler(filename=str(tmp_path / "stress.json"))
+    p.set_state(True)
+    stop = threading.Event()
+
+    def writer(i):
+        while not stop.is_set():
+            with p.scope(f"op{i}", args={"i": i}):
+                pass
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(20):
+            doc = json.load(open(p.dump()))
+            assert "traceEvents" in doc  # parseable mid-flight
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    doc = json.load(open(p.dump()))
+    assert all("name" in e for e in doc["traceEvents"])
+
+
+def test_measure_summary_percentiles_and_atomic_dump(tmp_path):
+    m = Measure(output_path=str(tmp_path / "m.json"))
+    for i in range(100):
+        m.add(loss=float(100 - i), note="s")  # non-numeric field skipped
+    s = m.summary()
+    pct = s["percentiles"]["loss"]
+    assert pct["p50"] == pytest.approx(50.5)
+    assert pct["p95"] == pytest.approx(95.05)
+    assert pct["p99"] == pytest.approx(99.01)
+    assert "note" not in s["percentiles"]
+    path = m.dump()
+    doc = json.load(open(path))
+    assert len(doc["records"]) == 100
+    assert doc["summary"]["percentiles"]["loss"]["p50"] == \
+        pytest.approx(50.5)
+    # overwrite dump is atomic: the file parses after a second dump too
+    m.add(loss=0.0)
+    json.load(open(m.dump()))
+
+
+# --------------------------------------------------------------------------
+# cross-party tracing
+# --------------------------------------------------------------------------
+
+def test_merge_traces_aligns_skewed_party_clocks(tmp_path):
+    """Two parties with skewed monotonic zeros: the merge must order
+    events by true wall clock (via the dump anchors), not by each
+    party's local timestamps."""
+    pa, pb = Profiler(rank=0), Profiler(rank=1)
+    pa.set_state(True)
+    pb.set_state(True)
+    # party B's clock starts 5 "seconds" later in wall time; its local
+    # ts values are SMALLER even though its events happen later
+    pa._anchor_unix_us = 1_000_000_000.0
+    pb._anchor_unix_us = 1_005_000_000.0
+    pa.add_event("a-early", 100.0, 200.0,
+                 args={"key": "w", "round_id": 1})
+    pb.add_event("b-late", 50.0, 150.0,
+                 args={"key": "w", "round_id": 1})
+    path_a = pa.dump(str(tmp_path / "a.json"))
+    path_b = pb.dump(str(tmp_path / "b.json"))
+    merged = merge_traces([path_a, path_b], labels=["A", "B"])
+    spans = {e["name"]: e for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans["a-early"]["ts"] < spans["b-late"]["ts"]
+    assert spans["b-late"]["ts"] - spans["a-early"]["ts"] == \
+        pytest.approx(5_000_000.0 - 50.0)
+    assert merged["metadata"]["clock_aligned"] is True
+    # the shared round produced a flow chain in ts order: start on the
+    # earlier (A) span, finish on the later (B) span
+    flows = [e for e in merged["traceEvents"]
+             if e.get("cat") == "wan_round"]
+    assert {f["ph"] for f in flows} == {"s", "f"}
+    start = next(f for f in flows if f["ph"] == "s")
+    finish = next(f for f in flows if f["ph"] == "f")
+    assert start["pid"] == spans["a-early"]["pid"]
+    assert finish["pid"] == spans["b-late"]["pid"]
+    # per-process name metadata survives
+    names = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {0: "A", 1: "B"}
+
+
+def test_two_party_wan_rounds_share_round_id(tmp_path):
+    """Acceptance: a 2-party in-process run produces ONE merged Chrome
+    trace where every WAN round's push/merge/pull spans share a
+    round_id across processes."""
+    glob = GeoPSServer(num_workers=2, mode="sync", rank=0).start()
+    locs = [GeoPSServer(num_workers=1, mode="sync", rank=r + 1,
+                        global_addr=("127.0.0.1", glob.port)).start()
+            for r in range(2)]
+    for s in (glob, *locs):
+        s.profiler.set_state(True)
+    clients = [GeoPSClient(("127.0.0.1", s.port), sender_id=i)
+               for i, s in enumerate(locs)]
+    n_rounds = 3
+    try:
+        for c in clients:
+            c.init("w", np.zeros(64, np.float32))
+        for rnd in range(n_rounds):
+            for i, c in enumerate(clients):
+                c.push("w", np.full(64, float(i + 1), np.float32))
+            for c in clients:
+                np.testing.assert_allclose(c.pull("w", timeout=60.0), 3.0)
+        paths = [s.profiler.dump(str(tmp_path / f"rank{s.rank}.json"))
+                 for s in (glob, *locs)]
+    finally:
+        for c in clients:
+            c.stop_server()
+            c.close()
+        glob.join(10)
+        for s in locs:
+            s.join(10)
+
+    merged = merge_traces(paths, labels=["global", "party0", "party1"])
+    rounds = {rk: evs for rk, evs in rounds_in_trace(merged).items()
+              if rk[0] == "w"}
+    assert set(r for _k, r in rounds) == set(range(1, n_rounds + 1))
+    for (key, rid), evs in rounds.items():
+        names = {e["name"].split(":")[0] for e in evs}
+        # the global tier saw both parties' pushes, closed the merge,
+        # and answered the pulls; each party's relay span carries the
+        # same round id
+        assert "ServerPush" in names and "ServerMerge" in names, \
+            (key, rid, names)
+        assert "RelayToGlobal" in names, (key, rid, names)
+        assert "ServerPull" in names, (key, rid, names)
+        # ... across >= 2 distinct processes (global + a party)
+        assert len({e["pid"] for e in evs}) >= 2
+    # every round id is consistent within its group by construction of
+    # rounds_in_trace; the merged doc is one loadable Chrome trace
+    out = tmp_path / "merged.json"
+    out.write_text(json.dumps(merged))
+    assert json.loads(out.read_text())["metadata"]["merged_from"] == 3
+
+
+# --------------------------------------------------------------------------
+# in-graph probes
+# --------------------------------------------------------------------------
+
+def _mini_trainer(telemetry: bool, tmp_events: str = ""):
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    cfg = GeoConfig(num_parties=2, workers_per_party=1,
+                    compression="bsc,0.05,min_sparse_size=16",
+                    telemetry=telemetry, telemetry_events=tmp_events)
+    return Trainer(MLP(num_classes=10, hidden=(32,)), topo,
+                   optax.sgd(0.1), sync=get_sync_algorithm(cfg),
+                   config=cfg, donate=False)
+
+
+def _mini_batch():
+    rng = np.random.RandomState(0)
+    x = (rng.rand(2, 1, 4, 8, 8, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(2, 1, 4)).astype(np.int32)
+    return x, y
+
+
+def test_disabled_telemetry_jaxpr_is_byte_identical(monkeypatch):
+    """THE overhead guarantee: with GEOMX_TELEMETRY off the traced step
+    is byte-identical (modulo function addresses) to a build where the
+    probe collector cannot even be called."""
+    monkeypatch.delenv("GEOMX_TELEMETRY", raising=False)
+    x, y = _mini_batch()
+    tr = _mini_trainer(False)
+    state = tr.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    sharding = tr.topology.batch_sharding(tr.mesh)
+    xb, yb = jax.device_put(x, sharding), jax.device_put(y, sharding)
+    j_off = canonicalize_jaxpr(
+        str(jax.make_jaxpr(tr.train_step)(state, xb, yb)))
+    assert "telemetry" not in j_off
+
+    def _poison(*a, **k):
+        raise AssertionError("probe collector ran on the disabled path")
+
+    monkeypatch.setattr(probes_mod, "collect_step_probes", _poison)
+    tr2 = _mini_trainer(False)
+    j_base = canonicalize_jaxpr(
+        str(jax.make_jaxpr(tr2.train_step)(state, xb, yb)))
+    assert j_off == j_base
+
+
+def test_enabled_probes_report_step_health(tmp_path):
+    events = str(tmp_path / "events.jsonl")
+    x, y = _mini_batch()
+    tr = _mini_trainer(True, tmp_events=events)
+    state = tr.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    sharding = tr.topology.batch_sharding(tr.mesh)
+    xb, yb = jax.device_put(x, sharding), jax.device_put(y, sharding)
+    state, metrics = tr.train_step(state, xb, yb)
+    m = jax.device_get(metrics)
+    t = m["telemetry"]
+    assert float(t["grad_all_finite"]) == 1.0
+    assert float(t["grad_nonfinite_count"]) == 0.0
+    np.testing.assert_array_equal(np.asarray(t["party_grad_nonfinite"]),
+                                  [0.0, 0.0])
+    assert float(t["grad_norm_global"]) > 0.0
+    # wire accounting: BSC at ratio 0.5 on the bucketed layout
+    assert 0 < float(t["dc_wire_bytes"]) < float(t["dc_dense_bytes"])
+    assert float(t["dc_compression_ratio"]) > 1.0
+    # in-situ achieved density: the aggregated top-k gradient is sparse
+    assert 0.0 < float(t["dc_nonzero_fraction"]) <= 1.0
+    # EF residual exists after one step (mass held back by top-k)
+    assert float(t["ef_residual_norm"]) >= 0.0
+    # BSC recorded its emitted fraction inline from inside the compressor
+    assert 0.0 < float(t["bsc_emitted_fraction"]) <= 1.0
+
+    # host-plane publication: registry gauges + JSONL events
+    tr._publish_telemetry(t, iteration=1)
+    reg = get_registry()
+    assert reg.get("geomx_step_probe").labels(
+        probe="grad_norm_global").value > 0
+    assert reg.get("geomx_step_probe_party").labels(
+        probe="party_grad_nonfinite", party="0").value == 0.0
+    ev = [e for e in EventLog(events).read() if e["kind"] == "step_probes"]
+    assert ev and ev[-1]["grad_norm_global"] > 0
+    # loss/accuracy metrics unchanged by the probe rider
+    assert set(m) == {"loss", "accuracy", "num_live_parties", "telemetry"}
+
+
+def test_party_nonfinite_probe_names_the_poisoned_party():
+    """The per-party NaN probe must point at the culprit even though
+    the aggregate hides it: party 1's raw gradient carries a NaN, party
+    0's is clean."""
+    from jax.sharding import PartitionSpec as P
+    from geomx_tpu.parallel.collectives import shard_map_compat
+    from geomx_tpu.sync import FSA
+
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    mesh = topo.build_mesh()
+    sync = FSA()
+    sync.bind_topology(topo)
+
+    def f(g):
+        local = {"w": g[0, 0]}
+        out = probes_mod.collect_step_probes(
+            local, None, sync, {"dc_comp": (), "worker_comp": ()},
+            None, local)
+        return out["party_grad_nonfinite"], out["grad_nonfinite_parties"]
+
+    g = np.zeros((2, 1, 64), np.float32)
+    g[1, 0, 7] = np.nan
+    mapped = jax.jit(shard_map_compat(
+        f, mesh, in_specs=(P("dc", "worker"),), out_specs=(P(), P())))
+    vec, total = mapped(jax.device_put(
+        g, topo.batch_sharding(mesh)))
+    np.testing.assert_array_equal(np.asarray(vec), [0.0, 1.0])
+    assert float(total) == 1.0
+
+
+def test_probe_replication_excludes_dead_parties():
+    """Degraded membership: a dead party's devices still run the step,
+    so probe scalars must fold to the SURVIVOR mean (the dead party's
+    zeros/garbage must not dilute the in-situ numbers)."""
+    from jax.sharding import PartitionSpec as P
+    from geomx_tpu.parallel.collectives import shard_map_compat
+    from geomx_tpu.sync import FSA
+
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    mesh = topo.build_mesh()
+    sync = FSA()
+    sync.bind_topology(topo)
+    sync.bind_membership((True, False))  # party 1 dead
+
+    def f(v):
+        return probes_mod._replicate(v[0, 0], sync)
+
+    vals = np.array([[4.0], [100.0]], np.float32).reshape(2, 1)
+    mapped = jax.jit(shard_map_compat(
+        f, mesh, in_specs=(P("dc", "worker"),), out_specs=P()))
+    out = mapped(jax.device_put(vals, topo.batch_sharding(mesh)))
+    # survivor mean = 4.0; a naive pmean would report 52.0
+    assert float(out) == 4.0
+
+
+def test_fit_publishes_probes_at_log_boundaries(tmp_path):
+    events = str(tmp_path / "fit_events.jsonl")
+    tr = _mini_trainer(True, tmp_events=events)
+    rng = np.random.RandomState(1)
+    flat_x = (rng.rand(32, 8, 8, 3) * 255).astype(np.uint8)
+    flat_y = rng.randint(0, 10, size=(32,)).astype(np.int32)
+    state = tr.init_state(jax.random.PRNGKey(0), flat_x[:2])
+    loader = tr.make_loader(flat_x, flat_y, batch_size=8)
+    state, recs = tr.fit(state, loader, epochs=2, log_every=1,
+                         log_fn=lambda s: None)
+    reg = get_registry()
+    assert reg.get("geomx_train_steps_total")._solo().value >= 2
+    assert reg.get("geomx_dc_wire_bytes_total")._solo().value > 0
+    ev = [e for e in EventLog(events).read() if e["kind"] == "step_probes"]
+    assert len(ev) >= 2 and ev[-1]["iteration"] > ev[0]["iteration"]
