@@ -14,10 +14,9 @@ Run (virtual 8-device mesh):
 """
 
 import argparse
-import time
-
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

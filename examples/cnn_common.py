@@ -2,10 +2,9 @@
 mirroring the shared structure of the reference's cnn_*.py family."""
 
 import argparse
-import time
-
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

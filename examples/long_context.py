@@ -21,7 +21,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
+import numpy as np  # noqa: E402
 
 
 def make_needle_data(n, seq_len, num_classes=10, vocab=256, seed=0):

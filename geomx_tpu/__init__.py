@@ -38,9 +38,9 @@ Reference layer map and parity inventory: see SURVEY.md at the repo root.
 
 __version__ = "0.1.0"
 
-from geomx_tpu.topology import (HiPSTopology, DC_AXIS, SP_AXIS,
-                                WORKER_AXIS)
 from geomx_tpu.config import GeoConfig
+from geomx_tpu.topology import (DC_AXIS, SP_AXIS, WORKER_AXIS,
+                                HiPSTopology)
 
 __all__ = [
     "HiPSTopology",

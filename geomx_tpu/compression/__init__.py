@@ -13,13 +13,13 @@ Spec-string surface mirrors the reference's "type,threshold" encoding
 """
 
 from geomx_tpu.compression.base import Compressor, NoCompressor, get_compressor
-from geomx_tpu.compression.fp16 import FP16Compressor
-from geomx_tpu.compression.twobit import TwoBitCompressor
 from geomx_tpu.compression.bisparse import BiSparseCompressor
-from geomx_tpu.compression.mpq import MPQCompressor
 from geomx_tpu.compression.bucketing import (BucketedCompressor,
                                              GradientBucketer,
                                              maybe_bucketed)
+from geomx_tpu.compression.fp16 import FP16Compressor
+from geomx_tpu.compression.mpq import MPQCompressor
+from geomx_tpu.compression.twobit import TwoBitCompressor
 
 __all__ = [
     "Compressor",

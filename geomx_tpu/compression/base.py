@@ -65,7 +65,7 @@ class Compressor(abc.ABC):
         return leaf.size * jnp.dtype(leaf.dtype).itemsize
 
     def wire_bytes(self, grads: Any) -> int:
-        return sum(self.wire_bytes_leaf(l) for l in jax.tree.leaves(grads))
+        return sum(self.wire_bytes_leaf(leaf) for leaf in jax.tree.leaves(grads))
 
 
 def default_on_tpu(env_var: str) -> bool:
@@ -73,6 +73,7 @@ def default_on_tpu(env_var: str) -> bool:
     to "0"; off (and deterministic) everywhere else.  Used for the fused
     Pallas 2-bit kernels and BSC's approximate top-k."""
     import os
+    # graftlint: disable=GXL006 — build-time gate
     if os.environ.get(env_var) == "0":
         return False
     try:

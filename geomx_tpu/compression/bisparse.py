@@ -78,6 +78,7 @@ class BiSparseCompressor(Compressor):
             else:
                 # empty string (an unset-but-exported launcher variable)
                 # falls back to the platform default
+                # graftlint: disable=GXL006 — constructor default
                 select = os.environ.get("GEOMX_BSC_SELECT") or None
             if select is None:
                 if fused or (fused is None and fused_kernels_enabled()):

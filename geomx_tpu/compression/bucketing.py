@@ -99,9 +99,9 @@ class GradientBucketer:
         self.fused_interpret = bool(fused_interpret)
         self.pad_to = max(1, int(pad_to))
         self.capacity = max(self.pad_to, int(bucket_bytes) // 4)
-        self.leaf_shapes = [tuple(l.shape) for l in leaves]
-        self.leaf_dtypes = [jnp.dtype(l.dtype) for l in leaves]
-        self.leaf_sizes = [int(l.size) for l in leaves]
+        self.leaf_shapes = [tuple(leaf.shape) for leaf in leaves]
+        self.leaf_dtypes = [jnp.dtype(leaf.dtype) for leaf in leaves]
+        self.leaf_sizes = [int(leaf.size) for leaf in leaves]
 
         # leaf -> (bucket, offset); bucket -> true fill
         self.assignments: List[Tuple[int, int]] = []
@@ -137,7 +137,7 @@ class GradientBucketer:
         the bit-identical fallback and parity oracle."""
         if self.fused and self.num_buckets > 0:
             from geomx_tpu.ops.bucket_pallas import fused_flatten
-            flat = [l.reshape(-1).astype(jnp.float32) for l in leaves]
+            flat = [leaf.reshape(-1).astype(jnp.float32) for leaf in leaves]
             return fused_flatten(flat, self._layout(),
                                  tuple(self.bucket_sizes),
                                  interpret=self.fused_interpret)
@@ -174,6 +174,7 @@ class GradientBucketer:
 def _resolve_bucket_bytes(bucket_bytes: Optional[int]) -> int:
     if bucket_bytes is not None:
         return int(bucket_bytes)
+    # graftlint: disable=GXL006 — constructor default
     raw = os.environ.get("GEOMX_BUCKET_BYTES")
     if raw:
         return int(float(raw))
@@ -212,7 +213,7 @@ class BucketedCompressor(Compressor):
 
     # -- layout cache (one per tree structure, resolved at trace time) ------
     def _bucketer(self, leaves: Sequence[Any]) -> GradientBucketer:
-        key = tuple((tuple(l.shape), jnp.dtype(l.dtype).str) for l in leaves)
+        key = tuple((tuple(leaf.shape), jnp.dtype(leaf.dtype).str) for leaf in leaves)
         bk = self._bucketers.get(key)
         if bk is None:
             bk = GradientBucketer(leaves, self.bucket_bytes, self.pad_to,

@@ -127,6 +127,16 @@ class GeoConfig:
     # one rotation generation)
     telemetry_events: str = ""
 
+    # ---- static analysis (analysis/: the Graft Auditor; docs/analysis.md)
+    # Off by default.  When on, the Trainer checks the collective
+    # signature of every membership-recompiled step program against the
+    # active program at the apply_membership boundary (a divergent
+    # signature deadlocks/diverges a multi-party mesh at run time).
+    audit: bool = False
+    # findings at or above this severity raise AuditError; below it they
+    # only log ("info" | "warning" | "error")
+    audit_severity: str = "error"
+
     # ---- resilience (resilience/: membership epochs, degraded-mode sync,
     # deterministic chaos; docs/resilience.md)
     # residual policy at a membership change: "reset" re-initializes
@@ -183,6 +193,8 @@ class GeoConfig:
                 ["GEOMX_HEARTBEAT_TIMEOUT", "PS_HEARTBEAT_TIMEOUT"], 15.0, float),
             telemetry=_env_bool(["GEOMX_TELEMETRY"], False),
             telemetry_events=_env(["GEOMX_TELEMETRY_EVENTS"], "", str),
+            audit=_env_bool(["GEOMX_AUDIT"], False),
+            audit_severity=_env(["GEOMX_AUDIT_SEVERITY"], "error", str),
             resilience_residuals=_env(
                 ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
             resilience_min_live=_env(

@@ -1,13 +1,13 @@
 """Data pipeline: datasets, party/worker sharding samplers, host loader,
 RecordIO packed format + prefetching record iterator."""
 
-from geomx_tpu.data.samplers import SplitSampler, ClassSplitSampler
-from geomx_tpu.data.datasets import load_dataset, DATASETS
+from geomx_tpu.data.datasets import DATASETS, load_dataset
 from geomx_tpu.data.loader import GeoDataLoader
-from geomx_tpu.data.recordio import (RecordIOReader, RecordIOWriter,
-                                     recordio_reader, recordio_writer,
-                                     pack_labelled, unpack_labelled)
 from geomx_tpu.data.record_iter import ImageRecordIter, PrefetchIter
+from geomx_tpu.data.recordio import (RecordIOReader, RecordIOWriter,
+                                     pack_labelled, recordio_reader,
+                                     recordio_writer, unpack_labelled)
+from geomx_tpu.data.samplers import ClassSplitSampler, SplitSampler
 
 __all__ = ["SplitSampler", "ClassSplitSampler", "load_dataset", "DATASETS",
            "GeoDataLoader", "RecordIOReader", "RecordIOWriter",

@@ -33,7 +33,8 @@ from typing import Iterator, Optional, Tuple
 import jax
 import numpy as np
 
-from geomx_tpu.data.samplers import SplitSampler, ClassSplitSampler, class_sorted_indices
+from geomx_tpu.data.samplers import (ClassSplitSampler, SplitSampler,
+                                     class_sorted_indices)
 from geomx_tpu.topology import HiPSTopology
 
 

@@ -184,6 +184,7 @@ def unpack_labelled(payload: bytes) -> Tuple[float, "np.ndarray"]:
 # work doesn't pay the interpreter.  GEOMX_NATIVE_RECORDIO=0 opts out.
 
 def recordio_writer(path: str, index: bool = True):
+    # graftlint: disable=GXL006 — host I/O kill-switch
     if os.environ.get("GEOMX_NATIVE_RECORDIO", "1") != "0":
         try:
             from geomx_tpu.runtime.native import (NativeRecordIOWriter,
@@ -196,6 +197,7 @@ def recordio_writer(path: str, index: bool = True):
 
 
 def recordio_reader(path: str):
+    # graftlint: disable=GXL006 — host I/O kill-switch
     if os.environ.get("GEOMX_NATIVE_RECORDIO", "1") != "0":
         try:
             from geomx_tpu.runtime.native import (NativeRecordIOReader,

@@ -6,7 +6,8 @@ The reference's demo workloads are Gluon CNNs on MNIST/FashionMNIST/CIFAR10
 
 from geomx_tpu.models.cnn import GeoCNN
 from geomx_tpu.models.mlp import MLP, AlexNet
-from geomx_tpu.models.resnet import ResNet, ResNet20, ResNet32, ResNet56, ResNet18
+from geomx_tpu.models.resnet import (ResNet, ResNet18, ResNet20, ResNet32,
+                                     ResNet56)
 from geomx_tpu.models.seq_classifier import SeqClassifier
 
 __all__ = ["GeoCNN", "MLP", "AlexNet",

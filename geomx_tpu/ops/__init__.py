@@ -24,16 +24,16 @@ Kernels run natively on TPU and in Pallas interpret mode elsewhere
 compression kernels (``fused_kernels_enabled``).
 """
 
+from geomx_tpu.ops.bsc_pallas import (bsc_scatter_add, bsc_select_pack,
+                                      fused_kernels_enabled)
+from geomx_tpu.ops.bucket_pallas import fused_flatten, fused_unflatten
 from geomx_tpu.ops.flash_attention import (flash_attention,
                                            flash_attention_bwd,
                                            flash_attention_with_lse,
                                            fused_attention,
                                            fused_attention_supported)
-from geomx_tpu.ops.twobit_pallas import (quantize_2bit, dequantize_2bit,
-                                         pallas_supported)
-from geomx_tpu.ops.bsc_pallas import (bsc_select_pack, bsc_scatter_add,
-                                      fused_kernels_enabled)
-from geomx_tpu.ops.bucket_pallas import fused_flatten, fused_unflatten
+from geomx_tpu.ops.twobit_pallas import (dequantize_2bit, pallas_supported,
+                                         quantize_2bit)
 
 __all__ = ["quantize_2bit", "dequantize_2bit", "pallas_supported",
            "bsc_select_pack", "bsc_scatter_add", "fused_kernels_enabled",

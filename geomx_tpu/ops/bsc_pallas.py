@@ -184,11 +184,11 @@ def _select_kernel(k, n, g_ref, u_ref, v_ref, thr_ref, vals_seed, idx_seed,
         ci.wait()
 
     @pl.when((pas == 0) & (blk == 0))
-    def _():
+    def _reset_primary_count():
         cnt[0] = 0
 
     @pl.when(pas == 0)
-    def _():
+    def _emit_primaries():
         p_pre = cnt[0]
         keep_p = primary & (p_pre + p_rank < k)
         # interim EF state (pass 1 rewrites it with the tie zeroing too)
@@ -198,12 +198,12 @@ def _select_kernel(k, n, g_ref, u_ref, v_ref, thr_ref, vals_seed, idx_seed,
         cnt[0] = p_pre + p_cnt
 
     @pl.when((pas == 1) & (blk == 0))
-    def _():
+    def _reset_tie_counts():
         cnt[1] = 0
         cnt[2] = 0
 
     @pl.when(pas == 1)
-    def _():
+    def _emit_ties():
         np_tot = cnt[0]  # total primaries: ties queue after ALL of them
         p_pre = cnt[1]
         s_pre = cnt[2]
@@ -297,7 +297,7 @@ def _scatter_kernel(out_rows, vals_ref, idx_ref, out_ref):
     chunk = pl.program_id(1)
 
     @pl.when(chunk == 0)
-    def _():
+    def _zero_output_block():
         out_ref[:] = jnp.zeros_like(out_ref)
 
     ix = idx_ref[:]                                             # [S, 1]
@@ -312,7 +312,7 @@ def _scatter_kernel(out_rows, vals_ref, idx_ref, out_ref):
     cmin = jnp.min(jnp.where(ix >= 0, ixf, jnp.float32(2. ** 31)))
 
     @pl.when((cmax >= lo - 256) & (cmin < hi + 256))
-    def _():
+    def _scatter_window():
         valid = ix >= 0
         row = jnp.where(valid, ix // _LANES - blk * out_rows, -1)
         col = jnp.where(valid, ix % _LANES, -1)

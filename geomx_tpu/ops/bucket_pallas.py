@@ -123,7 +123,7 @@ def fused_flatten(leaves: Sequence[jax.Array],
         scratch_shapes=[pltpu.SemaphoreType.DMA(
             (nleaves + len(tail_pads),))],
         interpret=interpret,
-    )(*[l.reshape(-1, 1) for l in leaves],
+    )(*[leaf.reshape(-1, 1) for leaf in leaves],
       jnp.zeros((pad_block, 1), jnp.float32))
     buckets = out if isinstance(out, (tuple, list)) else (out,)
     return [b.reshape(-1) for b in buckets]
@@ -152,4 +152,4 @@ def fused_unflatten(buckets: Sequence[jax.Array],
         interpret=interpret,
     )(*[b.reshape(-1, 1) for b in buckets])
     leaves = out if isinstance(out, (tuple, list)) else (out,)
-    return [l.reshape(-1) for l in leaves]
+    return [leaf.reshape(-1) for leaf in leaves]

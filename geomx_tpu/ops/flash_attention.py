@@ -104,11 +104,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
     @pl.when(ik == num_k - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-20)  # fully-masked rows -> 0 out
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        l_sum = jnp.maximum(l_ref[:, :1], 1e-20)  # fully-masked rows -> 0 out
+        o_ref[0] = (acc_ref[:] / l_sum).astype(o_ref.dtype)
         if lse_ref is not None:
             # logsumexp per row, for the backward's p = exp(s - lse)
-            lse_ref[0] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l),
+            lse_ref[0] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l_sum),
                                           (block_q, _LANES))
 
 
@@ -372,6 +372,7 @@ def fused_attention_supported() -> bool:
     """True when the native kernel path is active: on TPU, unless the
     GEOMX_FLASH_ATTN=0 kill-switch forces the dense fallback."""
     import os
+    # graftlint: disable=GXL003,GXL006 — build-time gate
     if os.environ.get("GEOMX_FLASH_ATTN", "1") == "0":
         return False
     try:

@@ -8,9 +8,9 @@ the reference adds over stock MXNet; it is provided both as a standalone
 optax transform and fused into ``sync.MixedSync``.
 """
 
-from geomx_tpu.optim.dcasgd import dcasgd
-
 import optax
+
+from geomx_tpu.optim.dcasgd import dcasgd
 
 
 def get_optimizer(name: str, learning_rate=0.01, **kw):

@@ -2,13 +2,13 @@
 and MultiGPS-style sharded updates."""
 
 from geomx_tpu.parallel.collectives import (
-    shard_map_compat,
-    hier_psum,
     hier_pmean,
-    psum_worker,
-    psum_dc,
-    pmean_worker,
+    hier_psum,
     pmean_dc,
+    pmean_worker,
+    psum_dc,
+    psum_worker,
+    shard_map_compat,
 )
 
 __all__ = [

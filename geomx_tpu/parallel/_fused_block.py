@@ -1,6 +1,6 @@
 """Fused flash-accumulation block for ring attention.
 
-One ring hop updates the streaming-softmax state (m, l, o) with the
+One ring hop updates the streaming-softmax state (m, l_acc, o) with the
 attention of the local Q block against the K/V block currently held —
 `ring_attention._block` in jnp.  This module is the Pallas version of
 that single hop: carries come IN as arrays and go OUT updated, so the
@@ -25,13 +25,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
-_LANES = 128  # m/l are lane-replicated 2-D (TPU Mosaic tiling)
+_LANES = 128  # m/l_acc are lane-replicated 2-D (TPU Mosaic tiling)
 
 
 def _hop_kernel(q_ref, k_ref, v_ref, m_in, l_in, o_in,
                 m_out, l_out, o_out, *, scale, block_q, block_k, diag):
     """Grid (BH, nq, nk), k innermost.  q/o blocks [1, bq, D]; k/v
-    [1, bk, D]; m/l blocks [1, bq, LANES] (lane-replicated).  The
+    [1, bk, D]; m/l_acc blocks [1, bq, LANES] (lane-replicated).  The
     incoming state seeds the accumulation at ik == 0; the final tile
     writes the updated state out — o stays UN-normalized (o_new =
     o*corr + p@v), exactly like the jnp `_block`."""
@@ -79,9 +79,9 @@ def _hop_kernel(q_ref, k_ref, v_ref, m_in, l_in, o_in,
         _accumulate()
 
 
-def _hop_pallas(q, k, v, m, l, o, scale, diag, block, interpret):
-    """q [BH, Lq, D]; k, v [BH, Lk, D]; m, l [BH, Lq]; o [BH, Lq, D]
-    (all f32).  Returns updated (m, l, o)."""
+def _hop_pallas(q, k, v, m, l_acc, o, scale, diag, block, interpret):
+    """q [BH, Lq, D]; k, v [BH, Lk, D]; m, l_acc [BH, Lq]; o [BH, Lq, D]
+    (all f32).  Returns updated (m, l_acc, o)."""
     BH, Lq, D = q.shape
     Lk = k.shape[1]
     bq, bk = min(block, Lq), min(block, Lk)
@@ -90,7 +90,7 @@ def _hop_pallas(q, k, v, m, l, o, scale, diag, block, interpret):
                          f"by {block}")
     nq, nk = Lq // bq, Lk // bk
     m2 = jnp.broadcast_to(m[..., None], (BH, Lq, _LANES))
-    l2 = jnp.broadcast_to(l[..., None], (BH, Lq, _LANES))
+    l2 = jnp.broadcast_to(l_acc[..., None], (BH, Lq, _LANES))
 
     kernel = functools.partial(_hop_kernel, scale=scale, block_q=bq,
                                block_k=bk, diag=diag)
@@ -113,11 +113,11 @@ def _hop_pallas(q, k, v, m, l, o, scale, diag, block, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
-def fused_block(q, k, v, m, l, o, scale, diag, block, interpret):
+def fused_block(q, k, v, m, l_acc, o, scale, diag, block, interpret):
     """Pallas flash hop with the jnp `_block`'s exact gradient.
 
     Layouts match `ring_attention._block`: q/o [B, Lq, H, D], k/v
-    [B, Lk, H, D], m/l [B, H, Lq]; all f32; returns (m, l, o) updated.
+    [B, Lk, H, D], m/l_acc [B, H, Lq]; all f32; returns (m, l_acc, o) updated.
     """
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
@@ -127,28 +127,28 @@ def fused_block(q, k, v, m, l, o, scale, diag, block, interpret):
 
     m_o, l_o, o_o = _hop_pallas(
         bh(q, Lq), bh(k, Lk), bh(v, Lk),
-        m.reshape(B * H, Lq), l.reshape(B * H, Lq), bh(o, Lq),
+        m.reshape(B * H, Lq), l_acc.reshape(B * H, Lq), bh(o, Lq),
         scale, diag, block, interpret)
     return (m_o.reshape(B, H, Lq), l_o.reshape(B, H, Lq),
             o_o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3))
 
 
-def _jnp_block(q, k, v, m, l, o, scale, diag):
+def _jnp_block(q, k, v, m, l_acc, o, scale, diag):
     from geomx_tpu.parallel.ring_attention import _block
     mask = (jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
             if diag else None)
-    return _block(q, k, v, m, l, o, scale, mask)
+    return _block(q, k, v, m, l_acc, o, scale, mask)
 
 
-def _fused_fwd(q, k, v, m, l, o, scale, diag, block, interpret):
-    return (fused_block(q, k, v, m, l, o, scale, diag, block, interpret),
-            (q, k, v, m, l, o))
+def _fused_fwd(q, k, v, m, l_acc, o, scale, diag, block, interpret):
+    return (fused_block(q, k, v, m, l_acc, o, scale, diag, block, interpret),
+            (q, k, v, m, l_acc, o))
 
 
 def _fused_bwd(scale, diag, block, interpret, res, g):
-    q, k, v, m, l, o = res
+    q, k, v, m, l_acc, o = res
     _, vjp = jax.vjp(
-        lambda *a: _jnp_block(*a, scale, diag), q, k, v, m, l, o)
+        lambda *a: _jnp_block(*a, scale, diag), q, k, v, m, l_acc, o)
     return vjp(g)
 
 

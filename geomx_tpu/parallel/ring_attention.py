@@ -26,10 +26,10 @@ import numpy as np
 from jax import lax
 
 
-def _block(q, k, v, m, l, o, scale, mask):
+def _block(q, k, v, m, l_acc, o, scale, mask):
     """One flash-attention accumulation step.
 
-    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; m, l: [B, H, Lq]; o like q.
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; m, l_acc: [B, H, Lq]; o like q.
     mask: [Lq, Lk] boolean or None.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -42,7 +42,7 @@ def _block(q, k, v, m, l, o, scale, mask):
     if mask is not None:
         p = jnp.where(mask[None, None], p, 0.0)
     corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-    l_new = l * corr + jnp.sum(p, axis=-1)
+    l_new = l_acc * corr + jnp.sum(p, axis=-1)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + \
         jnp.einsum("bhqk,bkhd->bqhd", p, v)
     return m_new, l_new, o_new
@@ -104,7 +104,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           tri if diag else None)
 
     def body(step, carry):
-        m, l, o, kk, vv = carry
+        m, l_acc, o, kk, vv = carry
         # kv block currently held came from device (idx - step) mod n
         src = (idx - step) % n
         if causal:
@@ -119,22 +119,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             def skip(m_, l_, o_):
                 return m_, l_, o_
 
-            m, l, o = lax.cond(
+            m, l_acc, o = lax.cond(
                 src == idx, masked,
                 lambda m_, l_, o_: lax.cond(src < idx, full, skip, m_, l_, o_),
-                m, l, o)
+                m, l_acc, o)
         else:
-            m, l, o = hop(m, l, o, kk, vv, False)
+            m, l_acc, o = hop(m, l_acc, o, kk, vv, False)
         # rotate K/V around the ring (skip after the final block)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
-        return m, l, o, kk, vv
+        return m, l_acc, o, kk, vv
 
-    m, l, o, _, _ = lax.fori_loop(
+    m, l_acc, o, _, _ = lax.fori_loop(
         0, n, body, (m0, l0, o0, k.astype(jnp.float32), v.astype(jnp.float32)))
-    l = jnp.maximum(l, 1e-20)
-    out = o / l.transpose(0, 2, 1)[..., None]
+    l_acc = jnp.maximum(l_acc, 1e-20)
+    out = o / l_acc.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 

@@ -63,7 +63,7 @@ def _streaming_attention(q, k, v, causal: bool,
     o0 = jnp.zeros(q.shape, jnp.float32)
 
     def body(i, carry):
-        m, l, o = carry
+        m, l_acc, o = carry
         kk = lax.dynamic_slice_in_dim(kf, i * blk, blk, axis=1)
         vv = lax.dynamic_slice_in_dim(vf, i * blk, blk, axis=1)
         k_pos = i * blk + jnp.arange(blk)
@@ -72,11 +72,11 @@ def _streaming_attention(q, k, v, causal: bool,
             mask = mask & (q_pos[:, None] >= k_pos[None, :])
         else:
             mask = jnp.broadcast_to(mask, (L, blk))
-        return _block(qf, kk, vv, m, l, o, scale, mask)
+        return _block(qf, kk, vv, m, l_acc, o, scale, mask)
 
-    m, l, o = lax.fori_loop(0, nb, body, (m0, l0, o0))
-    l = jnp.maximum(l, 1e-20)
-    return o / l.transpose(0, 2, 1)[..., None]
+    m, l_acc, o = lax.fori_loop(0, nb, body, (m0, l0, o0))
+    l_acc = jnp.maximum(l_acc, 1e-20)
+    return o / l_acc.transpose(0, 2, 1)[..., None]
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -119,6 +119,7 @@ class GeoPSClient:
         # so network QoS can demote the deferred channels exactly as in
         # the reference.  GEOMX_DGT_DSCP: comma ladder per channel
         # (default "34,26,18,10" = AF41..AF11), "off"/"0" disables.
+        # graftlint: disable=GXL006 — host-plane knob
         self._dgt_dscp = self._parse_dscp(os.environ.get("GEOMX_DGT_DSCP"))
         self._dgt_ch_socks: Dict[int, tuple] = {}
         self._dgt_ch_lock = threading.Lock()
@@ -157,6 +158,7 @@ class GeoPSClient:
                                               socket.SOCK_STREAM)
             self._ts_listener.setsockopt(socket.SOL_SOCKET,
                                          socket.SO_REUSEADDR, 1)
+            # graftlint: disable=GXL006 — host-plane knob
             bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
             self._ts_listener.bind((bind_host, 0))
             self._ts_listener.listen(16)
@@ -177,6 +179,7 @@ class GeoPSClient:
             # launcher-set party host — right when workers share the
             # server's machine, wrong across machines: multi-host
             # tunneled workers must set GEOMX_RELAY_HOST explicitly.
+            # graftlint: disable=GXL006 — host-plane knob
             adv = os.environ.get("GEOMX_RELAY_HOST")
             if not adv:
                 if bind_host in ("127.0.0.1", "localhost", "::1"):
@@ -191,6 +194,7 @@ class GeoPSClient:
                         # nothing about THIS host's reachable address —
                         # fall back to the launcher-set party host, then
                         # loopback (single-host deployments)
+                        # graftlint: disable=GXL006 — host-plane knob
                         adv = (os.environ.get("GEOMX_PS_HOST")
                                or "127.0.0.1")
                 else:
@@ -647,6 +651,7 @@ class GeoPSClient:
 
         rnd = self._key_rounds.get(key, 0) + 1
         self._key_rounds[key] = rnd
+        # graftlint: disable=GXL006 — host-plane knob
         max_q = int(os.environ.get("GEOMX_DGT_MAX_QUEUE", "256"))
         rids = []
         shed = 0
@@ -893,6 +898,7 @@ class GeoPSClient:
             # one seq for every attempt at this partial: the receiver
             # dedups retransmits by (from, seq)
             seq = next(self._relay_seq)
+            # graftlint: disable=GXL006 — host-plane knob
             retries = int(os.environ.get("GEOMX_RELAY_RETRIES", "3"))
             t0 = time.monotonic()
             delivered = False
@@ -953,6 +959,7 @@ class GeoPSClient:
             # an OSError) rather than wedge the single dispatch thread
             # forever (ADVICE r3 #4); the dispatcher retries the same
             # (from, seq) frame so a slow-but-alive peer dedups
+            # graftlint: disable=GXL006 — host-plane knob
             sock.settimeout(float(os.environ.get(
                 "GEOMX_RELAY_TIMEOUT_S", "30")))
             self._ts_peers[addr] = sock

@@ -118,7 +118,7 @@ class Msg:
 # ---- fault injection (reference PS_DROP_MSG, van.cc:510-512: received
 # data messages are dropped with the given percentage probability) ---------
 
-import random as _random
+import random as _random  # noqa: E402 — fault-injection section stays self-contained
 
 _drop_rng = _random.Random(0xD209)
 
@@ -245,7 +245,9 @@ def _verbose_level() -> int:
     global _verbose_cache
     if _verbose_cache is None:
         try:
+            # graftlint: disable=GXL006 — host-plane knob
             _verbose_cache = int(os.environ.get("GEOMX_PS_VERBOSE")
+                                 # graftlint: disable=GXL006 — host-plane knob
                                  or os.environ.get("PS_VERBOSE") or "0")
         except ValueError:
             _verbose_cache = 0

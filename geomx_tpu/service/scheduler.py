@@ -76,6 +76,7 @@ class GeoScheduler:
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if bind_host is None:
+            # graftlint: disable=GXL006 — host-plane knob
             bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
         self._srv.bind((bind_host, port))
         self._srv.listen(64)
@@ -114,6 +115,7 @@ class GeoScheduler:
         self._metrics_srv = None
         self.metrics_port: Optional[int] = None
         if metrics_port is None:
+            # graftlint: disable=GXL006 — host-plane knob
             raw = os.environ.get("GEOMX_METRICS_PORT")
             if raw not in (None, ""):
                 try:
@@ -446,7 +448,9 @@ class SchedulerClient:
         GEOMX_HEARTBEAT_INTERVAL (PS_HEARTBEAT_INTERVAL alias) seconds."""
         if interval_s is None:
             interval_s = float(
+                # graftlint: disable=GXL006 — host-plane knob
                 os.environ.get("GEOMX_HEARTBEAT_INTERVAL")
+                # graftlint: disable=GXL006 — host-plane knob
                 or os.environ.get("PS_HEARTBEAT_INTERVAL") or "3")
         if self._hb_stop is not None:
             return self

@@ -139,15 +139,19 @@ class GeoPSServer:
         # waiting for their pulls (DefaultAutoPull -> AutoPullUpdate,
         # kvstore_dist_server.h:1372-1395, kv_app.h:658-691)
         if auto_pull is None:
+            # graftlint: disable=GXL006 — host-plane knob
             auto_pull = bool(int(os.environ.get(
                 "GEOMX_ENABLE_INTRA_TS",
+                # graftlint: disable=GXL006 — host-plane knob
                 os.environ.get("ENABLE_INTRA_TS", "0")) or 0))
         self.ts_sched = None
         if auto_pull:
             from geomx_tpu.transport.tsengine import TSEngineScheduler
             if max_greed_rate is None:
+                # graftlint: disable=GXL006 — host-plane knob
                 max_greed_rate = float(os.environ.get(
                     "GEOMX_MAX_GREED_RATE",
+                    # graftlint: disable=GXL006 — host-plane knob
                     os.environ.get("MAX_GREED_RATE_TS", "0.9")) or 0.9)
             self.ts_sched = TSEngineScheduler(num_workers,
                                               max_greed_rate=max_greed_rate,
@@ -278,6 +282,7 @@ class GeoPSServer:
         # loopback by default (pseudo-distributed); multi-host deployments
         # bind all interfaces via bind_host="0.0.0.0" or GEOMX_PS_BIND_HOST
         if bind_host is None:
+            # graftlint: disable=GXL006 — host-plane knob
             bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
         self._srv.bind((bind_host, port))
         self._srv.listen(64)
@@ -762,6 +767,7 @@ class GeoPSServer:
         else is an optax transform.  GEOMX_NATIVE_SGD=0 opts out."""
         self._native_sgd = None
         use_native = (name in ("sgd", "momentum")
+                      # graftlint: disable=GXL006 — host-plane gate
                       and os.environ.get("GEOMX_NATIVE_SGD", "1") != "0")
         if use_native:
             try:
@@ -1010,7 +1016,7 @@ class GeoPSServer:
             return hasattr(leaf, "shape") and tuple(leaf.shape) == shape
 
         state_rows = jax.tree.map(
-            lambda l: jnp.asarray(l)[ridx] if is_rowwise(l) else l,
+            lambda leaf: jnp.asarray(leaf)[ridx] if is_rowwise(leaf) else leaf,
             self._opt_state[key])
         updates, new_state_rows = self._tx.update(
             jnp.asarray(vals), state_rows, ref[ridx])
@@ -1164,6 +1170,7 @@ class GeoPSServer:
             st["required_got"].add(int(msg.meta["chunk"]))
         if st["timer"] is None and \
                 len(st["required_got"]) >= st["num_required"]:
+            # graftlint: disable=GXL006 — host-plane knob
             deadline_s = float(os.environ.get(
                 "GEOMX_DGT_DEADLINE_MS", "200")) / 1000.0
             t = threading.Timer(deadline_s, self._dgt_finalize,

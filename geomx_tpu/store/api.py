@@ -241,9 +241,12 @@ class KVStore:
         grad_rows = jnp.zeros((len(uniq),) + ref.shape[1:], ref.dtype)
         grad_rows = grad_rows.at[jnp.asarray(inverse)].add(all_v)
 
-        is_rowwise = lambda leaf: (
-            hasattr(leaf, "shape") and leaf.shape == ref.shape)
-        gather = lambda leaf: leaf[rows] if is_rowwise(leaf) else leaf
+        def is_rowwise(leaf):
+            return hasattr(leaf, "shape") and leaf.shape == ref.shape
+
+        def gather(leaf):
+            return leaf[rows] if is_rowwise(leaf) else leaf
+
         param_rows = ref[rows]
         state_rows = jax.tree.map(gather, self._opt_state[key])
         updates, new_state_rows = self._tx.update(

@@ -12,10 +12,10 @@ device-local party state threaded through the TrainState.
 """
 
 from geomx_tpu.sync.base import SyncAlgorithm
+from geomx_tpu.sync.dgt import DGTCompressor
 from geomx_tpu.sync.fsa import FSA
 from geomx_tpu.sync.hfa import HFA
 from geomx_tpu.sync.mixed import MixedSync
-from geomx_tpu.sync.dgt import DGTCompressor
 from geomx_tpu.sync.pipeline import PipelinedSync
 
 __all__ = ["SyncAlgorithm", "FSA", "HFA", "MixedSync", "DGTCompressor",

@@ -165,7 +165,7 @@ class SyncAlgorithm(abc.ABC):
         if dc is not None:
             leaves = jax.tree.leaves(params)
             dense = float(sum(
-                l.size * np.dtype(l.dtype).itemsize for l in leaves))
+                leaf.size * np.dtype(leaf.dtype).itemsize for leaf in leaves))
             wire = float(dc.wire_bytes(params))
             out["dc_wire_bytes"] = wire
             out["dc_dense_bytes"] = dense

@@ -137,7 +137,7 @@ class DGTCompressor(Compressor):
     # -- tree-level fast path (see module docstring: one schedule for the
     # -- whole gradient instead of one per leaf) ---------------------------
     def init_state(self, grads: Any) -> Any:
-        n = sum(l.size for l in jax.tree.leaves(grads))
+        n = sum(leaf.size for leaf in jax.tree.leaves(grads))
         padded = self._nblocks(n) * self.block_elems
         flat = jnp.zeros((padded,), jnp.float32)
         return {
@@ -150,10 +150,10 @@ class DGTCompressor(Compressor):
     def allreduce(self, grads: Any, state: Any, axis_name: str,
                   axis_size: int) -> Tuple[Any, Any]:
         leaves, treedef = jax.tree.flatten(grads)
-        n = sum(l.size for l in leaves)
+        n = sum(leaf.size for leaf in leaves)
         padded = self._nblocks(n) * self.block_elems
         flat = jnp.concatenate(
-            [l.reshape(-1).astype(jnp.float32) for l in leaves])
+            [leaf.reshape(-1).astype(jnp.float32) for leaf in leaves])
         gf = jnp.zeros((padded,), jnp.float32).at[:n].set(flat)
         sendable, new_state = self._defer_schedule(gf, state)
         # the inner compressor sees ONE flat vector — its error-feedback /
@@ -162,10 +162,10 @@ class DGTCompressor(Compressor):
             sendable, state["inner"], axis_name, axis_size)
         new_state["inner"] = inner_state
         out, off = [], 0
-        for l in leaves:
-            out.append(summed[off:off + l.size].reshape(l.shape)
-                       .astype(l.dtype))
-            off += l.size
+        for leaf in leaves:
+            out.append(summed[off:off + leaf.size].reshape(leaf.shape)
+                       .astype(leaf.dtype))
+            off += leaf.size
         return treedef.unflatten(out), new_state
 
     def wire_bytes_leaf(self, leaf: jax.Array) -> int:

@@ -91,6 +91,7 @@ from geomx_tpu.utils.profiler import get_profiler, profile_scope
 def _resolve_depth(depth: Optional[int]) -> int:
     if depth is not None:
         return int(depth)
+    # graftlint: disable=GXL006 — wrap-time knob
     raw = os.environ.get("GEOMX_PIPELINE_DEPTH")
     return int(float(raw)) if raw else 1
 
@@ -128,8 +129,8 @@ class PipelinedCompressor(Compressor):
             inflight: List[jax.Array] = [jnp.zeros((n,), jnp.float32)
                                          for n in bk.bucket_sizes]
         else:
-            inflight = [jnp.zeros(jnp.shape(l), jnp.result_type(l))
-                        for l in leaves]
+            inflight = [jnp.zeros(jnp.shape(leaf), jnp.result_type(leaf))
+                        for leaf in leaves]
         return {"inflight": inflight, "inner": self.inner.init_state(grads)}
 
     def init_leaf_state(self, leaf: jax.Array) -> Any:

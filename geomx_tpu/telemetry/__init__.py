@@ -11,12 +11,12 @@
   event log.
 """
 
-from geomx_tpu.telemetry.registry import (MetricRegistry, get_registry,
-                                          reset_registry)
-from geomx_tpu.telemetry.probes import telemetry_enabled
 from geomx_tpu.telemetry.export import (EventLog, get_event_log, log_event,
                                         parse_prometheus_text,
                                         render_prometheus)
+from geomx_tpu.telemetry.probes import telemetry_enabled
+from geomx_tpu.telemetry.registry import (MetricRegistry, get_registry,
+                                          reset_registry)
 from geomx_tpu.telemetry.tracing import merge_traces, rounds_in_trace
 
 __all__ = [

@@ -328,7 +328,9 @@ def set_default_event_log(log: Optional[EventLog]) -> None:
 
 def get_event_log() -> Optional[EventLog]:
     global _event_log, _event_log_key
+    # graftlint: disable=GXL006 — config-less surface
     path = os.environ.get("GEOMX_TELEMETRY_EVENTS") or ""
+    # graftlint: disable=GXL006 — config-less surface
     raw_cap = os.environ.get("GEOMX_TELEMETRY_EVENTS_MAX_BYTES") or ""
     with _event_log_lock:
         if _default_log is not None:

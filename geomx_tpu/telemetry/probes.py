@@ -119,15 +119,15 @@ def record_inline(name: str, value_fn) -> None:
 # ---------------------------------------------------------------------------
 
 def _float_leaves(tree) -> List[jax.Array]:
-    return [l for l in jax.tree.leaves(tree)
-            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+    return [leaf for leaf in jax.tree.leaves(tree)
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)]
 
 
 def _tree_sumsq(tree) -> jax.Array:
     leaves = _float_leaves(tree)
     if not leaves:
         return jnp.zeros((), jnp.float32)
-    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
 
 
 def tree_norm(tree) -> jax.Array:
@@ -139,8 +139,8 @@ def _nonfinite_count(tree) -> jax.Array:
     leaves = _float_leaves(tree)
     if not leaves:
         return jnp.zeros((), jnp.float32)
-    return sum(jnp.sum((~jnp.isfinite(l)).astype(jnp.float32))
-               for l in leaves)
+    return sum(jnp.sum((~jnp.isfinite(leaf)).astype(jnp.float32))
+               for leaf in leaves)
 
 
 def _replicate(x: jax.Array, sync: Any) -> jax.Array:
@@ -177,7 +177,6 @@ def collect_step_probes(raw_grads: Any, synced_grads: Optional[Any],
     """
     from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
     nw = getattr(sync, "workers_per_party", 1)
-    np_ = getattr(sync, "num_parties", 1)
     out: Dict[str, jax.Array] = {}
 
     # per-party NaN/Inf flag from the RAW gradients: aggregation (and a
@@ -196,8 +195,8 @@ def collect_step_probes(raw_grads: Any, synced_grads: Optional[Any],
         out["grad_nonfinite_count"] = bad
         out["grad_all_finite"] = (bad == 0).astype(jnp.float32)
         leaves = _float_leaves(synced_grads)
-        total = sum(l.size for l in leaves) or 1
-        nz = sum(jnp.sum((l != 0).astype(jnp.float32)) for l in leaves) \
+        total = sum(leaf.size for leaf in leaves) or 1
+        nz = sum(jnp.sum((leaf != 0).astype(jnp.float32)) for leaf in leaves) \
             if leaves else jnp.zeros((), jnp.float32)
         out["dc_nonzero_fraction"] = nz / total
 

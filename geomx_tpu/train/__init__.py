@@ -1,7 +1,8 @@
 """The SPMD training engine: state, step builder, high-level trainer."""
 
 from geomx_tpu.train.state import TrainState, replicate_tree, unreplicate_tree
-from geomx_tpu.train.step import build_train_step, build_eval_step, make_loss_fn
+from geomx_tpu.train.step import (build_eval_step, build_train_step,
+                                  make_loss_fn)
 from geomx_tpu.train.trainer import Trainer
 
 __all__ = ["TrainState", "replicate_tree", "unreplicate_tree",

@@ -235,8 +235,11 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         return params, opt_state, sync_state
 
     def _device_step(state: TrainState, x, y):
-        squeeze = lambda t: jax.tree.map(lambda a: a[0, 0], t)
-        expand = lambda t: jax.tree.map(lambda a: a[None, None], t)
+        def squeeze(t):
+            return jax.tree.map(lambda a: a[0, 0], t)
+
+        def expand(t):
+            return jax.tree.map(lambda a: a[None, None], t)
         params = squeeze(state.params)
         opt_state = squeeze(state.opt_state)
         model_state = squeeze(state.model_state)

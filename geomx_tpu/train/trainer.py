@@ -90,6 +90,18 @@ class Trainer:
         # logging (no extra device round trips)
         from geomx_tpu.telemetry.probes import telemetry_enabled
         self._telemetry = telemetry_enabled(self.config)
+        # graft auditor (analysis/, docs/analysis.md): when enabled, the
+        # fit loop captures the active step program's collective
+        # signature once (cheap: one abstract trace) and every
+        # apply_membership recompile is diffed against it — a membership
+        # mask must change CONSTANTS, never the collective sequence, or
+        # live and recovering parties deadlock/diverge at the next epoch
+        from geomx_tpu.analysis import audit_enabled, audit_severity_gate
+        self._audit = audit_enabled(self.config)
+        self._audit_gate = audit_severity_gate(self.config) \
+            if self._audit else "error"
+        self._audit_args = None     # (state, x, y) ShapeDtypeStructs
+        self._audit_sigs: dict = {}  # membership key -> signature
         self._telem_last_it = 0
         self._event_log = None
         events_path = getattr(self.config, "telemetry_events", "")
@@ -127,7 +139,7 @@ class Trainer:
                 # flat schedule per layout group under MultiGPS — shard
                 # leaves and replicated leaves must not share blocks
                 # (train/step.py _mgps_sync_update splits the same way)
-                sizes = [l.size for l in jax.tree.leaves(params)]
+                sizes = [leaf.size for leaf in jax.tree.leaves(params)]
                 big, small = self._mgps.split_mixed(
                     sizes, jax.tree.leaves(mixed))
                 sync_state = dict(sync_state, dc_comp={
@@ -236,6 +248,13 @@ class Trainer:
                 self.mesh, donate=self._donate, config=self.config,
                 sp_model=self._sp_model)
             self._step_cache[key] = step_fn
+        # graft auditor at the recompile boundary (GEOMX_AUDIT): the
+        # new membership's program must trace the SAME ordered
+        # collective sequence as the reference program — masking changes
+        # constants, never collectives.  Raises AuditError (before the
+        # swap) on divergence at/above the severity gate; call
+        # apply_membership again after fixing the config to rebind.
+        self._audit_membership_program(key, step_fn)
         self.train_step = step_fn
         # both close over the previous membership's traced program
         self._epoch_runners.clear()
@@ -250,6 +269,61 @@ class Trainer:
             step=state.step, params=state.params,
             opt_state=state.opt_state, model_state=state.model_state,
             sync_state=replicate_tree(new_ss, self.topology, self.mesh))
+
+    # ---- graft auditor (analysis/, docs/analysis.md) ----------------------
+
+    def _step_signature(self, step_fn):
+        """Collective signature + single-program consistency findings of
+        a step program, traced on the abstract (ShapeDtypeStruct)
+        reference arguments captured by the fit loop."""
+        from geomx_tpu.analysis import (AuditContext,
+                                        CollectiveConsistencyPass)
+        st, xb, yb = self._audit_args
+        ctx = AuditContext()
+        findings = CollectiveConsistencyPass().run(
+            jax.make_jaxpr(step_fn)(st, xb, yb), ctx)
+        return ctx.extras["collective_signature"], findings
+
+    def _audit_capture(self, state: TrainState, xb, yb) -> None:
+        """Arm the auditor: record abstract step arguments and the
+        active program's collective signature (once per Trainer; the
+        first fit batch with GEOMX_AUDIT on).  One abstract trace — no
+        compile, no device work."""
+        if not self._audit or self._audit_args is not None:
+            return
+        self._audit_args = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (state, xb, yb))
+        self._audit_sigs[self._membership] = self._step_signature(
+            self.train_step)
+
+    def _audit_membership_program(self, key, step_fn) -> None:
+        """Audit the membership program about to be installed: its
+        collective signature is diffed against the armed reference
+        (divergence is GX-COLLECTIVE-002, always error severity — a
+        program pair that deadlocks has no soft form) and the program's
+        own consistency findings (e.g. the axis_index_groups warning)
+        join in.  Findings at/above GEOMX_AUDIT_SEVERITY raise
+        AuditError; below it they surface as warnings."""
+        if not self._audit or self._audit_args is None:
+            return
+        cached = self._audit_sigs.get(key)
+        if cached is None:
+            cached = self._step_signature(step_fn)
+            self._audit_sigs[key] = cached
+        sig, prog_findings = cached
+        ref_key, (ref, _) = next(iter(self._audit_sigs.items()))
+        if key == ref_key:
+            return
+        from geomx_tpu.analysis import (diff_collective_signatures,
+                                        enforce)
+        findings = enforce(list(prog_findings) + diff_collective_signatures(
+            {f"membership={ref_key}": ref, f"membership={key}": sig},
+            rule_id="GX-COLLECTIVE-002"), self._audit_gate)
+        if findings:  # below the gate: surface without stopping the run
+            import warnings
+            warnings.warn("\n".join(f.format() for f in findings),
+                          RuntimeWarning, stacklevel=3)
 
     def catchup_payload(self, state: TrainState) -> bytes:
         """The re-admission catch-up blob: one unreplicated copy of the
@@ -304,8 +378,11 @@ class Trainer:
             tx = self.tx
 
             def _drain(st):
-                squeeze = lambda t: jax.tree.map(lambda a: a[0, 0], t)
-                expand = lambda t: jax.tree.map(lambda a: a[None, None], t)
+                def squeeze(t):
+                    return jax.tree.map(lambda a: a[0, 0], t)
+
+                def expand(t):
+                    return jax.tree.map(lambda a: a[None, None], t)
                 params = squeeze(st.params)
                 opt_state = squeeze(st.opt_state)
                 model_state = squeeze(st.model_state)
@@ -583,6 +660,9 @@ class Trainer:
         it = 0
         for epoch in range(epochs):
             for xb, yb in loader.epoch(epoch):
+                # arm the auditor on the first batch (abstract trace of
+                # the active program; no-op unless GEOMX_AUDIT is on)
+                self._audit_capture(state, xb, yb)
                 state, metrics = self.train_step(state, xb, yb)
                 it += 1
                 fields = {}

@@ -1,10 +1,10 @@
 """Observability & ops utilities: metrics reporting, checkpointing,
 profiling, failure detection."""
 
-from geomx_tpu.utils.metrics import Measure
-from geomx_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
+from geomx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from geomx_tpu.utils.compile_cache import enable_compile_cache
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
+from geomx_tpu.utils.metrics import Measure
 from geomx_tpu.utils.net import free_port_blocks
 
 __all__ = ["Measure", "save_checkpoint", "load_checkpoint",

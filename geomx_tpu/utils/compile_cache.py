@@ -38,6 +38,7 @@ def enable_compile_cache(path: str | None = None,
         # only an UNSET path consults the env: an explicit path argument
         # (the test conftest, a framework embedder) must not be vetoed
         # by a GEOMX_COMPILE_CACHE=0 meant for the bench default
+        # graftlint: disable=GXL006 — pre-config opt-out
         env = os.environ.get("GEOMX_COMPILE_CACHE", "")
         if env == "0":
             return None
@@ -77,6 +78,7 @@ def enable_compile_cache(path: str | None = None,
                 on_cpu = jax.default_backend() == "cpu"
         except Exception:
             pass
+    # graftlint: disable=GXL006 — pre-config opt-out
     if on_cpu and os.environ.get("GEOMX_COMPILE_CACHE_CPU") != "1":
         return None
 

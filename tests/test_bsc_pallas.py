@@ -311,13 +311,15 @@ def test_bucket_kernels_lower_to_tpu_mosaic_without_a_device(rng):
 # ---------- lowered-HLO structure regression ----------
 
 def test_fused_paths_remove_dense_intermediates(rng):
-    """The structural claim of the fused kernel layer, checked the same
-    way bench.py --compare-kernels reports it: the ops that materialize
-    a dense gradient-sized intermediate in the unfused graphs (scatter,
-    cumsum expansion, per-leaf concatenate/slice copies) must be ABSENT
-    from the fused graphs, which instead carry one tpu_custom_call per
-    kernel."""
-    import bench
+    """The structural claim of the fused kernel layer, checked on the
+    shared lowered-HLO assertions library (geomx_tpu/analysis/hlo.py —
+    the same matchers bench.py --compare-kernels reports with): the ops
+    that materialize a dense gradient-sized intermediate in the unfused
+    graphs (scatter, cumsum expansion, per-leaf concatenate/slice
+    copies) must be ABSENT from the fused graphs, which instead carry
+    one tpu_custom_call per kernel."""
+    from geomx_tpu.analysis.hlo import (assert_dense_intermediates_removed,
+                                        compare_paths)
 
     n = 20000
     cj, _ = _pair(ratio=0.01)
@@ -331,38 +333,31 @@ def test_fused_paths_remove_dense_intermediates(rng):
     vals = jnp.zeros((m,), jnp.float32)
     idx = jnp.zeros((m,), jnp.int32)
 
-    sel = bench._hlo_verdict(
-        bench._hlo_materialization_counts(
-            lambda a, b, c: cj.compress(a, b, c), g, z, z),
-        bench._hlo_materialization_counts(
-            lambda a, b, c: cf.compress(a, b, c), g, z, z),
-        ("scatter", "reduce_window", "while", "dynamic_update_slice"))
-    assert sel["dense_intermediates_removed"], sel
-    assert sel["fused"]["tpu_custom_calls"] >= 1
+    sel = compare_paths(
+        lambda a, b, c: cj.compress(a, b, c),
+        lambda a, b, c: cf.compress(a, b, c), g, z, z,
+        dense_ops=("scatter", "reduce_window", "while",
+                   "dynamic_update_slice"))
+    assert_dense_intermediates_removed(sel)
     # the small-tensor ops both paths share (sample sort/gathers, pad
     # concats) stay; everything dense-sized is gone
     assert sel["dense_unfused"] >= 3 and sel["dense_fused"] == 0, sel
 
-    dec = bench._hlo_verdict(
-        bench._hlo_materialization_counts(
-            lambda a, b: cj.decompress(a, b, n), vals, idx),
-        bench._hlo_materialization_counts(
-            lambda a, b: cf.decompress(a, b, n), vals, idx),
-        ("scatter", "sort"))
-    assert dec["dense_intermediates_removed"], dec
-    assert dec["fused"]["tpu_custom_calls"] >= 1
+    dec = compare_paths(
+        lambda a, b: cj.decompress(a, b, n),
+        lambda a, b: cf.decompress(a, b, n), vals, idx,
+        dense_ops=("scatter", "sort"))
+    assert_dense_intermediates_removed(dec)
 
     leaves = [jnp.asarray(rng.normal(0, 1, s).astype(np.float32))
               for s in (432, 16, 2304, 16, 9216, 64, 640, 10)]
-    flat_v = bench._hlo_verdict(
-        bench._hlo_materialization_counts(
-            lambda *ls: GradientBucketer(
-                leaves, 65536, fused=False).flatten(list(ls)), *leaves),
-        bench._hlo_materialization_counts(
-            lambda *ls: GradientBucketer(
-                leaves, 65536, fused=True).flatten(list(ls)), *leaves),
-        ("concatenate", "dynamic_update_slice"))
-    assert flat_v["dense_intermediates_removed"], flat_v
+    flat_v = compare_paths(
+        lambda *ls: GradientBucketer(
+            leaves, 65536, fused=False).flatten(list(ls)),
+        lambda *ls: GradientBucketer(
+            leaves, 65536, fused=True).flatten(list(ls)), *leaves,
+        dense_ops=("concatenate", "dynamic_update_slice"))
+    assert_dense_intermediates_removed(flat_v)
     assert flat_v["fused"]["tpu_custom_calls"] == 1
 
 

@@ -198,9 +198,9 @@ def test_mpq_routes_at_bucket_granularity():
     leaves = {f"l{i}": jnp.zeros((200,), jnp.float32) for i in range(10)}
     mpq = MPQCompressor(ratio=0.05, size_lower_bound=1000)
     # per-leaf: every leaf is small -> fp16, no state
-    for l in jax.tree.leaves(leaves):
-        assert mpq.init_leaf_state(l) == ()
-        assert mpq.wire_bytes_leaf(l) == 200 * 2
+    for leaf in jax.tree.leaves(leaves):
+        assert mpq.init_leaf_state(leaf) == ()
+        assert mpq.wire_bytes_leaf(leaf) == 200 * 2
     bc = BucketedCompressor(MPQCompressor(ratio=0.05, size_lower_bound=1000),
                             bucket_bytes=1 << 20)
     st = bc.init_state(leaves)
@@ -243,7 +243,7 @@ def test_bucket_report_covers_every_leaf(rng):
     report = bc.bucket_report(tree)
     assert sum(r["leaves"] for r in report) == len(jax.tree.leaves(tree))
     assert sum(r["elems"] for r in report) == sum(
-        l.size for l in jax.tree.leaves(tree))
+        leaf.size for leaf in jax.tree.leaves(tree))
     assert all(r["wire_bytes"] == r["padded"] * 2 for r in report)
 
 

@@ -1,7 +1,8 @@
 import numpy as np
 import pytest
 
-from geomx_tpu.data import SplitSampler, ClassSplitSampler, load_dataset, GeoDataLoader
+from geomx_tpu.data import (ClassSplitSampler, GeoDataLoader, SplitSampler,
+                            load_dataset)
 from geomx_tpu.data.samplers import class_sorted_indices
 from geomx_tpu.topology import HiPSTopology
 
@@ -81,8 +82,8 @@ def test_loader_augmentation_preserves_shapes_and_labels():
     aug2 = GeoDataLoader(x, y, topo, batch_size=16, shuffle=False, seed=7,
                          augment=True)
 
-    (xp, yp), (xa, ya), (xa2, _) = (next(iter(l.epoch(0)))
-                                    for l in (plain, aug, aug2))
+    (xp, yp), (xa, ya), (xa2, _) = (next(iter(ld.epoch(0)))
+                                    for ld in (plain, aug, aug2))
     xp, xa, xa2 = (np.asarray(v) for v in (xp, xa, xa2))
     assert xa.shape == xp.shape and xa.dtype == xp.dtype
     np.testing.assert_array_equal(np.asarray(ya), np.asarray(yp))
@@ -115,8 +116,8 @@ def test_device_cache_loader_matches_host_path():
                         device_cache=True)
     aug2 = GeoDataLoader(x, y, topo, batch_size=8, seed=11, augment=True,
                          device_cache=True)
-    (xh, yh), (xa, ya), (xa2, _) = (next(iter(l.epoch(0)))
-                                    for l in (host, aug, aug2))
+    (xh, yh), (xa, ya), (xa2, _) = (next(iter(ld.epoch(0)))
+                                    for ld in (host, aug, aug2))
     xa, xa2 = np.asarray(xa), np.asarray(xa2)
     assert xa.shape == np.asarray(xh).shape and xa.dtype == np.uint8
     np.testing.assert_array_equal(np.asarray(ya), np.asarray(yh))

@@ -7,11 +7,10 @@ follows exactly the same trajectory as the un-sharded model on the plain
 2-D mesh — sequence parallelism changes the schedule, never the math.
 """
 
+import jax
 import numpy as np
 import optax
 import pytest
-
-import jax
 
 from geomx_tpu.models import SeqClassifier
 from geomx_tpu.sync import FSA

@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from geomx_tpu.ops import quantize_2bit, dequantize_2bit
+from geomx_tpu.ops import dequantize_2bit, quantize_2bit
 
 
 def test_quantize_2bit_roundtrip_and_error_feedback(rng):

@@ -13,12 +13,11 @@ in sync_state so checkpoints resume mid-pipeline bit-exactly.
 import os
 import sys
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+import pytest
 
 from geomx_tpu.config import GeoConfig
 from geomx_tpu.data.datasets import load_dataset
@@ -202,7 +201,7 @@ def test_pipelined_mixed_sync_composes(data):
     for i in range(4):
         state, metrics = trainer.train_step(state, *batches[i])
         losses.append(float(metrics["loss"]))
-    assert all(np.isfinite(l) for l in losses)
+    assert all(np.isfinite(leaf) for leaf in losses)
 
 
 def test_rejections_are_loud():

@@ -13,12 +13,11 @@ same failure scenario run to run.
 
 import time
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from geomx_tpu.models import GeoCNN
@@ -324,9 +323,10 @@ def test_mask_validation():
 # --------------------------------------------------------------------------
 
 def _dc_float_leaves(state):
-    return [l for l in jax.tree.leaves(
+    return [leaf for leaf in jax.tree.leaves(
         unreplicate_tree(state.sync_state)["dc_comp"])
-        if hasattr(l, "dtype") and np.issubdtype(l.dtype, np.floating)]
+        if hasattr(leaf, "dtype") and np.issubdtype(leaf.dtype,
+                                                    np.floating)]
 
 
 def test_residual_policy_reset_and_carry():
@@ -339,7 +339,7 @@ def test_residual_policy_reset_and_carry():
     for _ in range(2):
         state, _ = trainer.train_step(state, xb, yb)
     pre = _dc_float_leaves(state)
-    assert any(np.any(l != 0) for l in pre), "no residuals accumulated"
+    assert any(np.any(leaf != 0) for leaf in pre), "no residuals accumulated"
 
     s_carry = trainer.apply_membership(state, (True, False),
                                        policy="carry")
@@ -351,7 +351,7 @@ def test_residual_policy_reset_and_carry():
                                        policy="carry")
     s_reset = trainer.apply_membership(s_carry, (True, False),
                                        policy="reset")
-    assert all(not np.any(l) for l in _dc_float_leaves(s_reset)), \
+    assert all(not np.any(leaf) for leaf in _dc_float_leaves(s_reset)), \
         "reset policy left residuals behind"
     # the degraded program still runs from the reset state
     s2, m = trainer.train_step(s_reset, xb, yb)
@@ -401,7 +401,7 @@ def test_pipelined_carry_policy_drains_renormalized_aggregate():
     moved = any(not np.array_equal(a, b) for a, b in
                 zip(jax.tree.leaves(p_before), jax.tree.leaves(p_after)))
     assert moved, "carry policy drained a zero aggregate"
-    assert all(np.all(np.isfinite(l)) for l in jax.tree.leaves(p_after))
+    assert all(np.all(np.isfinite(leaf)) for leaf in jax.tree.leaves(p_after))
 
 
 # --------------------------------------------------------------------------

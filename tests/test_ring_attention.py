@@ -141,17 +141,17 @@ def test_ulysses_streaming_blocks_and_padding(causal):
 # ---------------------------------------------------------------------------
 
 def _rand_state(rng, B, Lq, H, D, hops_done):
-    """A mid-ring (m, l, o) state: -inf/zeros before any hop, realistic
+    """A mid-ring (m, l_acc, o) state: -inf/zeros before any hop, realistic
     running values after one."""
     if not hops_done:
         return (jnp.full((B, H, Lq), -jnp.inf, jnp.float32),
                 jnp.zeros((B, H, Lq), jnp.float32),
                 jnp.zeros((B, Lq, H, D), jnp.float32))
     m = jnp.asarray(rng.normal(size=(B, H, Lq)).astype(np.float32))
-    l = jnp.asarray(rng.uniform(0.5, 2.0, size=(B, H, Lq))
+    l_acc = jnp.asarray(rng.uniform(0.5, 2.0, size=(B, H, Lq))
                     .astype(np.float32))
     o = jnp.asarray(rng.normal(size=(B, Lq, H, D)).astype(np.float32))
-    return m, l, o
+    return m, l_acc, o
 
 
 @pytest.mark.parametrize("diag", [False, True])
@@ -164,12 +164,12 @@ def test_fused_block_matches_jnp_block(diag, hops_done):
     B, Lq, Lk, H, D = 2, 32, 32, 2, 16
     q, k, v = (jnp.asarray(rng.normal(size=(B, Lq, H, D))
                            .astype(np.float32)) for _ in range(3))
-    m, l, o = _rand_state(rng, B, Lq, H, D, hops_done)
+    m, l_acc, o = _rand_state(rng, B, Lq, H, D, hops_done)
     scale = 1.0 / np.sqrt(D)
 
     mask = jnp.tril(jnp.ones((Lq, Lk), bool)) if diag else None
-    m_r, l_r, o_r = _block(q, k, v, m, l, o, scale, mask)
-    m_f, l_f, o_f = fused_block(q, k, v, m, l, o, scale, diag, 16, True)
+    m_r, l_r, o_r = _block(q, k, v, m, l_acc, o, scale, mask)
+    m_f, l_f, o_f = fused_block(q, k, v, m, l_acc, o, scale, diag, 16, True)
     np.testing.assert_allclose(np.asarray(m_f), np.asarray(m_r),
                                atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(l_f), np.asarray(l_r),
@@ -186,16 +186,16 @@ def test_fused_block_gradients_match_jnp_block():
     B, Lq, H, D = 1, 16, 2, 8
     q, k, v = (jnp.asarray(rng.normal(size=(B, Lq, H, D))
                            .astype(np.float32)) for _ in range(3))
-    m, l, o = _rand_state(rng, B, Lq, H, D, 1)
+    m, l_acc, o = _rand_state(rng, B, Lq, H, D, 1)
     scale = 1.0 / np.sqrt(D)
 
     def loss_f(q, k, v):
-        mf, lf, of = fused_block(q, k, v, m, l, o, scale, True, 16, True)
+        mf, lf, of = fused_block(q, k, v, m, l_acc, o, scale, True, 16, True)
         return jnp.sum(of ** 2) + jnp.sum(lf) + jnp.sum(mf)
 
     def loss_r(q, k, v):
         mask = jnp.tril(jnp.ones((Lq, Lq), bool))
-        mr, lr, orr = _block(q, k, v, m, l, o, scale, mask)
+        mr, lr, orr = _block(q, k, v, m, l_acc, o, scale, mask)
         return jnp.sum(orr ** 2) + jnp.sum(lr) + jnp.sum(mr)
 
     gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
@@ -245,14 +245,14 @@ def test_fused_hop_lowers_to_tpu_mosaic_without_a_device():
     q, k, v = (jnp.asarray(rng.normal(size=(B, Lq, H, D))
                            .astype(np.float32)) for _ in range(3))
     m = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
-    l = jnp.zeros((B, H, Lq), jnp.float32)
+    l_acc = jnp.zeros((B, H, Lq), jnp.float32)
     o = jnp.zeros((B, Lq, H, D), jnp.float32)
 
-    def f(q, k, v, m, l, o):
-        return fused_block(q, k, v, m, l, o, 1.0 / np.sqrt(D), True,
+    def f(q, k, v, m, l_acc, o):
+        return fused_block(q, k, v, m, l_acc, o, 1.0 / np.sqrt(D), True,
                            128, False)
 
-    exp = jax_export.export(jax.jit(f), platforms=("tpu",))(q, k, v, m, l, o)
+    exp = jax_export.export(jax.jit(f), platforms=("tpu",))(q, k, v, m, l_acc, o)
     assert "tpu_custom_call" in exp.mlir_module()
 
 

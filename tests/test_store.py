@@ -104,7 +104,7 @@ def test_optimizer_state_save_load(tmp_path):
 
 
 def test_multigps_partition_parity():
-    from geomx_tpu.parallel.multigps import partition, HASH_PRIME
+    from geomx_tpu.parallel.multigps import HASH_PRIME, partition
     sizes = [100, 2_000_000, 500]
     placements = partition(sizes, num_servers=4, bigarray_bound=1_000_000)
     # small tensors: hashed whole to (key*9973) % num_servers

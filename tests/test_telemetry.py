@@ -22,11 +22,10 @@ import threading
 import urllib.error
 import urllib.request
 
+import jax
 import numpy as np
 import optax
 import pytest
-
-import jax
 
 from geomx_tpu.config import GeoConfig
 from geomx_tpu.models import MLP

@@ -1,7 +1,7 @@
 import jax
 import pytest
 
-from geomx_tpu.topology import HiPSTopology, DC_AXIS, WORKER_AXIS
+from geomx_tpu.topology import DC_AXIS, WORKER_AXIS, HiPSTopology
 
 
 def test_mesh_axes(topo2x4):

@@ -13,7 +13,7 @@ import pytest
 from geomx_tpu.compression import BiSparseCompressor, FP16Compressor, MPQCompressor
 from geomx_tpu.data.datasets import load_dataset
 from geomx_tpu.models import GeoCNN
-from geomx_tpu.sync import FSA, HFA, MixedSync, DGTCompressor
+from geomx_tpu.sync import FSA, HFA, DGTCompressor, MixedSync
 from geomx_tpu.topology import HiPSTopology
 from geomx_tpu.train import Trainer
 

@@ -8,11 +8,10 @@ gathered payload of the in-graph collective, and DGT's amortized
 deferral matching its actual send/drain schedule.
 """
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from geomx_tpu.compression import (BiSparseCompressor, FP16Compressor,
                                    MPQCompressor, TwoBitCompressor)

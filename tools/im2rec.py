@@ -19,9 +19,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
+import numpy as np  # noqa: E402
 
-from geomx_tpu.data.recordio import pack_labelled, recordio_writer
+from geomx_tpu.data.recordio import (  # noqa: E402
+    pack_labelled, recordio_writer)
 
 
 def from_dataset(name: str, split: str, root: str):
