@@ -44,6 +44,18 @@ Micro-modes:
       the DCE-verified count of dc collectives the weight update waits
       on (0 under pipelining), and the modeled step time / overlap ratio
       under an injected DCN delay.  CPU, no TPU needed.
+  bench.py --compare-zero [--model=resnet20] [--compression=bsc,0.01]
+           [--batch=32] [--steps=4]
+      One JSON line for the ZeRO-sharded bucketed weight update
+      (GEOMX_ZERO, train/zero.py) on a 2x4 CPU mesh: the DCE'd weight
+      path swaps the worker-tier allreduce for psum_scatter +
+      all_gather, per-chip optimizer-state bytes shrink ~1/W vs the
+      replicated update, final params match the replicated path within
+      1e-6 (vanilla, pipelined-drained, degraded-membership), and the
+      bsc shard path's wire format is bit-identical between the jnp
+      and fused kernels.  Runs in a watchdog-watched child: a wedge
+      publishes watchdog.phase/init_phases/stacks forensics.  CPU, no
+      TPU needed.
   bench.py --compare-resilience [--model=resnet20] [--steps=9]
            [--schedule="seed=1234;blackout@3:party=1,steps=3"]
            [--compression=none] [--pipeline-depth=0]
@@ -1466,6 +1478,380 @@ def compare_pipeline_main(argv):
 
 
 # --------------------------------------------------------------------------
+# --compare-zero: replicated vs ZeRO-sharded bucketed weight update
+# --------------------------------------------------------------------------
+
+
+def _axis_collective_breakdown(jaxpr, axis: str) -> dict:
+    """Per-primitive counts of collectives over the named mesh axis
+    (walker from the analysis subsystem, recursing into nested
+    jaxprs)."""
+    from geomx_tpu.analysis.core import walk_jaxpr
+    from geomx_tpu.analysis.passes import COLLECTIVE_PRIMS, _collective_axes
+    out = {}
+    for site in walk_jaxpr(jaxpr):
+        if site.primitive in COLLECTIVE_PRIMS \
+                and axis in _collective_axes(site.eqn):
+            out[site.primitive] = out.get(site.primitive, 0) + 1
+    return out
+
+
+def _weight_path_collectives(train_step, state, xb, yb) -> dict:
+    """The structural claim --compare-zero verifies: which collectives
+    the *weight update* waits on, per mesh axis.  DCE the traced step
+    keeping only the params/opt_state outputs (BatchNorm-stat pmeans
+    feed model_state and are excluded on purpose — they are statistics
+    maintenance, not the weight update), then break the surviving
+    collectives down per primitive.  Replicated FSA keeps its
+    worker-axis psum (the gradient allreduce); the ZeRO step keeps
+    psum_scatter + all_gather and NO worker-axis psum."""
+    import jax
+
+    closed = jax.make_jaxpr(train_step)(state, xb, yb)
+    out_shapes = jax.eval_shape(train_step, state, xb, yb)
+    flat, treedef = jax.tree.flatten(out_shapes)
+    idx_tree = jax.tree.unflatten(treedef, list(range(len(flat))))
+    new_state, _metrics = idx_tree
+    keep = set(jax.tree.leaves((new_state.params, new_state.opt_state)))
+    used = [i in keep for i in range(len(flat))]
+    try:
+        from jax._src.interpreters import partial_eval as pe
+        dced, _used_ins = pe.dce_jaxpr(closed.jaxpr, used)
+    except Exception as e:  # private API moved: report, don't guess
+        return {"analysis_error": repr(e)}
+    return {"worker_axis": _axis_collective_breakdown(dced, "worker"),
+            "dc_axis": _axis_collective_breakdown(dced, "dc")}
+
+
+def _bsc_shard_wire_format(shard_elems: int = 2048,
+                           ratio: float = 0.05) -> dict:
+    """PR 4's wire-format guarantee extended to shard-sized payloads:
+    the (values, indices) pairs one bucket *shard* emits must be
+    byte-identical between the jnp sampled path and the fused Pallas
+    kernels (interpret mode — runs on CPU)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomx_tpu.compression.bisparse import BiSparseCompressor
+
+    rng = np.random.RandomState(7)
+    g = jnp.asarray(rng.standard_normal(shard_elems), jnp.float32)
+    u = jnp.zeros_like(g)
+    v = jnp.zeros_like(g)
+    jnp_path = BiSparseCompressor(ratio=ratio, select="sampled",
+                                  fused=False, min_sparse_size=1)
+    fused_path = BiSparseCompressor(ratio=ratio, select="sampled",
+                                    fused=True, fused_interpret=True,
+                                    min_sparse_size=1)
+    va, ia, _, _ = jnp_path.compress(g, u, v)
+    vb, ib, _, _ = fused_path.compress(g, u, v)
+    ident = (np.asarray(va).tobytes() == np.asarray(vb).tobytes()
+             and np.asarray(ia).tobytes() == np.asarray(ib).tobytes())
+    return {"wire_format_bit_identical": bool(ident),
+            "wire_format_pairs": int(va.shape[0]),
+            "wire_format_shard_elems": shard_elems}
+
+
+def _compare_zero(model_name: str = "resnet20",
+                  compression: str = "bsc,0.01", batch: int = 32,
+                  steps: int = 4, on_phase=None):
+    """Replicated vs ZeRO-sharded weight update on a 2x4 CPU mesh
+    (train/zero.py, GEOMX_ZERO): one JSON line proving
+
+    (a) structure — in the DCE'd weight path the worker-tier gradient
+        allreduce is replaced by psum_scatter + all_gather;
+    (b) memory — per-chip optimizer-state bytes shrink ~1/W vs the
+        replicated update (state-shape accounting, plus XLA's
+        ``memory_analysis()`` where the backend provides it);
+    (c) parity — final params match the replicated path within 1e-6
+        for the vanilla config, composed with pipelined (drained) and
+        degraded-membership runs; the bsc shard path runs finite and
+        its wire format is bit-identical between the jnp and fused
+        kernels at shard sizes.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.analysis.passes import _GATHER_PRIMS, _SCATTER_PRIMS
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    def phase(name):
+        if on_phase is not None:
+            on_phase(name)
+
+    n_parties, n_workers = 2, 4
+    devs = jax.devices()
+    if len(devs) < n_parties * n_workers:
+        raise RuntimeError(
+            "compare-zero needs >= 8 devices for the 2x4 mesh (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    topo = HiPSTopology(num_parties=n_parties,
+                        workers_per_party=n_workers)
+    local_b = max(1, batch // (n_parties * n_workers))
+    rng = np.random.RandomState(0)
+    xs = (rng.rand(steps, n_parties, n_workers, local_b, 32, 32, 3)
+          * 255).astype(np.uint8)
+    ys = rng.randint(0, 10, size=(steps, n_parties, n_workers,
+                                  local_b)).astype(np.int32)
+
+    def build(zero, comp="none", pipeline=0, mask=None):
+        cfg = GeoConfig(num_parties=n_parties,
+                        workers_per_party=n_workers, zero=zero,
+                        compression=comp, pipeline_depth=pipeline)
+        tr = Trainer(get_model(model_name, num_classes=10), topo,
+                     optax.sgd(0.1, momentum=0.9),
+                     sync=get_sync_algorithm(cfg), config=cfg)
+        st = tr.init_state(jax.random.PRNGKey(0), xs[0, 0, 0, :2])
+        if mask is not None:
+            st = tr.apply_membership(st, mask)
+        return tr, st
+
+    def run(tr, st, drain=False):
+        sharding = topo.batch_sharding(tr.mesh)
+        for s in range(steps):
+            st, _m = tr.train_step(st, jax.device_put(xs[s], sharding),
+                                   jax.device_put(ys[s], sharding))
+        if drain:
+            st = tr.drain_pipeline(st)
+        jax.block_until_ready(st.step)
+        return st
+
+    def params00(st):
+        return jax.tree.map(lambda a: np.asarray(a, np.float64)[0, 0],
+                            st.params)
+
+    def gap(a, b):
+        return max(jax.tree.leaves(jax.tree.map(
+            lambda u, v: float(np.max(np.abs(u - v))), a, b)))
+
+    out = {"mode": "compare_zero", "model": model_name,
+           "topology": f"{n_parties}x{n_workers}",
+           "compression": compression, "batch": batch, "steps": steps}
+
+    # -- (a) structure + (b) memory on the vanilla pair ----------------------
+    phase("build_replicated")
+    tr_rep, st_rep = build(False)
+    sharding = topo.batch_sharding(tr_rep.mesh)
+    xb = jax.device_put(xs[0], sharding)
+    yb = jax.device_put(ys[0], sharding)
+    phase("build_zero")
+    tr_zero, st_zero = build(True)
+    phase("structure_analysis")
+    s_rep = _weight_path_collectives(tr_rep.train_step, st_rep, xb, yb)
+    s_zero = _weight_path_collectives(tr_zero.train_step, st_zero, xb, yb)
+
+    def fam_count(rec, fam):
+        return sum(v for k, v in rec.get("worker_axis", {}).items()
+                   if k in fam)
+
+    scat = fam_count(s_zero, _SCATTER_PRIMS)
+    gath = fam_count(s_zero, _GATHER_PRIMS)
+    psum_zero = s_zero.get("worker_axis", {}).get("psum", 0)
+    psum_rep = s_rep.get("worker_axis", {}).get("psum", 0)
+    out["structure"] = {
+        "replicated": s_rep, "zero": s_zero,
+        "zero_psum_scatter_on_weight_path": scat,
+        "zero_all_gather_on_weight_path": gath,
+        "zero_worker_allreduce_on_weight_path": psum_zero,
+        "worker_allreduce_replaced": bool(
+            scat and gath and psum_zero == 0 and psum_rep > 0
+            and fam_count(s_rep, _SCATTER_PRIMS) == 0),
+    }
+    phase("memory_analysis")
+    mem_rep = tr_rep.step_memory_stats(st_rep, xb, yb)
+    mem_zero = tr_zero.step_memory_stats(st_zero, xb, yb)
+    ratio = (mem_zero["opt_state_bytes_per_chip"]
+             / max(1.0, mem_rep["opt_state_bytes_per_chip"]))
+    out["memory"] = {
+        "replicated": mem_rep, "zero": mem_zero,
+        "opt_state_per_chip_ratio": round(ratio, 4),
+        "expected_ratio": round(1.0 / n_workers, 4),
+        # padding + per-bucket scalars keep the ratio a whisker above
+        # exactly 1/W; "shrinks" = at most halfway between 1/W and 1
+        "opt_state_shrinks_with_workers":
+            ratio <= (1.0 / n_workers + 1.0) / 2.0,
+    }
+
+    # -- (c) parity: vanilla, pipelined (drained), degraded ------------------
+    phase("parity_vanilla")
+    g_vanilla = gap(params00(run(tr_rep, st_rep)),
+                    params00(run(tr_zero, st_zero)))
+    parity = {"vanilla_gap": g_vanilla}
+    phase("parity_pipelined")
+    tr_a, st_a = build(False, pipeline=1)
+    tr_b, st_b = build(True, pipeline=1)
+    parity["pipelined_gap"] = gap(params00(run(tr_a, st_a, drain=True)),
+                                  params00(run(tr_b, st_b, drain=True)))
+    phase("parity_degraded")
+    tr_a, st_a = build(False, mask=(True, False))
+    tr_b, st_b = build(True, mask=(True, False))
+    parity["degraded_gap"] = gap(params00(run(tr_a, st_a)),
+                                 params00(run(tr_b, st_b)))
+    parity["tolerance"] = 1e-6
+    parity["within_tolerance"] = all(
+        v <= 1e-6 for k, v in parity.items() if k.endswith("_gap"))
+    out["parity"] = parity
+
+    # -- bsc: the compressed shard path --------------------------------------
+    phase("bsc_zero")
+    tr_b, st_b = build(True, comp=compression)
+    st_b = run(tr_b, st_b)
+    finite = all(bool(np.isfinite(np.asarray(leaf)).all())
+                 for leaf in jax.tree.leaves(st_b.params))
+    dc = tr_b.sync.dc_compressor
+    params0 = jax.tree.map(lambda a: a[0, 0], st_b.params)
+    wire = _bsc_shard_wire_format()
+    out["bsc"] = {
+        "finite": finite,
+        "shard_wire_bytes_per_chip": int(
+            dc.shard_wire_bytes(params0, n_workers)),
+        "bucket_wire_bytes_replicated": int(dc.wire_bytes(params0)),
+        **wire,
+    }
+    phase("verdict")
+    out["ok"] = bool(out["structure"]["worker_allreduce_replaced"]
+                     and out["memory"]["opt_state_shrinks_with_workers"]
+                     and parity["within_tolerance"] and finite
+                     and wire["wire_format_bit_identical"])
+    return out
+
+
+def _compare_zero_child(kwargs):
+    """The measurement half of --compare-zero, run in a watched child:
+    registers the SIGUSR1 faulthandler (the parent signals before
+    killing, so a wedge names its frame) and streams per-phase events
+    the parent folds into the record's forensics fields."""
+    t0 = time.monotonic()
+    try:
+        import faulthandler
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError, OSError):
+        pass  # unsupported platform: stack dumps just absent
+
+    def phase(name):
+        _emit({"event": "phase", "phase": name,
+               "elapsed_s": round(time.monotonic() - t0, 2)})
+
+    phase("child_start")
+    hang = os.environ.get("GEOMX_BENCH_FAULT_HANG_INIT")
+    if hang:
+        # test hook (shared with the main bench): wedge deterministically
+        # so the forensic path is exercisable in seconds
+        time.sleep(float(hang))
+    import jax  # backend init: the classic silent-wedge point
+    jax.devices()
+    phase("backend_up")
+    rec = _compare_zero(on_phase=phase, **kwargs)
+    _emit({"event": "result", "record": rec})
+
+
+def _compare_zero_parent(argv):
+    """Watchdog parent for --compare-zero (the BENCH_r05 lesson applied
+    to the micro-modes): the child is killed after ``timeout`` seconds
+    of SILENCE — the deadline re-arms on every phase event, so a
+    healthy-but-slow host streaming progress is never mistaken for a
+    wedge — and the emitted record still names the wedged phase
+    (``watchdog.phase``), carries the per-phase timestamp trail
+    (``init_phases``) and the child's all-thread stacks — never 480
+    silent seconds."""
+    timeout = float(os.environ.get("GEOMX_BENCH_TIMEOUT", "480"))
+    env = dict(os.environ, GEOMX_BENCH_COMPARE_CHILD="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--compare-zero",
+         *argv],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    q: "queue.Queue" = queue.Queue()
+    threading.Thread(target=_drain, args=(proc.stdout, q),
+                     daemon=True).start()
+    stderr_buf = []
+    stderr_thread = threading.Thread(target=lambda: stderr_buf.extend(
+        proc.stderr.read().splitlines()[-200:]), daemon=True)
+    stderr_thread.start()
+
+    record = None
+    phases = {}
+    last_phase = None
+    error = None
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            line = q.get(timeout=max(0.1, deadline - time.monotonic()))
+        except queue.Empty:
+            last = last_phase or "child_start"
+            error = (f"watchdog: --compare-zero made no progress for "
+                     f"{timeout:g}s in phase {last!r}")
+            try:
+                proc.send_signal(signal.SIGUSR1)
+                time.sleep(2.0)
+            except (OSError, AttributeError):
+                pass
+            proc.kill()
+            break
+        if line is None:
+            break
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        kind = ev.get("event")
+        if kind == "phase":
+            last_phase = str(ev.get("phase"))
+            phases[last_phase] = ev.get("elapsed_s")
+            deadline = time.monotonic() + timeout  # progress re-arms
+        elif kind == "result":
+            record = ev.get("record")
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    stderr_thread.join(timeout=5)
+    if error is None and record is None:
+        error = (f"compare-zero child exited rc={proc.poll()} without "
+                 "a result")
+    out = record if record is not None else {"mode": "compare_zero",
+                                             "ok": False}
+    if phases:
+        out["init_phases"] = phases
+    if error is not None:
+        out["error"] = error
+        out["watchdog"] = {
+            "phase": last_phase or "child_start",
+            "init_phases": dict(phases),
+            "stacks": stderr_buf[-120:],
+        }
+        if stderr_buf:
+            out["error"] += " | " + " | ".join(stderr_buf[-5:])[-2000:]
+    _emit(out)
+
+
+def compare_zero_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--compression="):
+            kwargs["compression"] = a.split("=", 1)[1]
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--steps="):
+            kwargs["steps"] = int(a.split("=", 1)[1])
+    if os.environ.get("GEOMX_BENCH_COMPARE_CHILD") == "1":
+        _compare_zero_child(kwargs)
+    else:
+        _compare_zero_parent([a for a in argv
+                              if a != "--compare-zero"])
+
+
+# --------------------------------------------------------------------------
 # --compare-resilience: seeded mid-run party blackout + re-admission
 # --------------------------------------------------------------------------
 
@@ -2302,13 +2688,15 @@ def main():
         compare_kernels_main(sys.argv[1:])
     elif "--audit" in sys.argv:
         # static-analysis acceptance smoke: in-process on the CPU
-        # backend with a 2-device virtual mesh (env before first import)
+        # backend with a 4-device virtual mesh (env before first
+        # import) — the scatter_wire_lie corpus entry needs a 4-wide
+        # axis for the (N-1)/N accounting gap to be visible
         os.environ.setdefault("JAX_PLATFORMS",
                               os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=2").strip()
+                flags + " --xla_force_host_platform_device_count=4").strip()
         audit_main(sys.argv[1:])
     elif "--compare-telemetry" in sys.argv:
         # telemetry acceptance micro-mode: in-process on the CPU backend
@@ -2330,6 +2718,19 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=2").strip()
         compare_resilience_main(sys.argv[1:])
+    elif "--compare-zero" in sys.argv:
+        # ZeRO sharded-update micro-mode: a 2x4 virtual mesh (8 CPU
+        # devices).  The measurement runs in a watchdog-watched child
+        # (parent half of compare_zero_main), so a wedged backend init
+        # publishes watchdog.phase forensics instead of burning the
+        # budget silently
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        compare_zero_main(sys.argv[1:])
     elif "--compare-pipeline" in sys.argv:
         # accounting/structure micro-mode like --compare-bucketing:
         # in-process on the CPU backend with a 2-device virtual mesh

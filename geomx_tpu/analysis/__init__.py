@@ -20,6 +20,7 @@ from geomx_tpu.analysis.passes import (CollectiveConsistencyPass,
                                        audit_cross_party, audit_donation,
                                        audit_dtype_flow,
                                        audit_wire_accounting,
+                                       audit_zero_compressed_path,
                                        collective_signature,
                                        diff_collective_signatures)
 
@@ -29,6 +30,7 @@ __all__ = [
     "PurityPass", "audit_compressed_path", "audit_cross_party",
     "audit_donation", "audit_dtype_flow", "audit_enabled",
     "audit_severity_gate", "audit_wire_accounting",
+    "audit_zero_compressed_path",
     "collective_signature", "diff_collective_signatures", "enforce",
     "run_passes", "summarize", "walk_jaxpr",
 ]

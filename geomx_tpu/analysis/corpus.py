@@ -17,6 +17,10 @@ Entries (name -> expected rule):
   declared-bf16 compute path
 - ``wire_accounting_lie``    -> GX-DTYPE-002        a compressor whose
   wire_bytes() claims half the bytes its collectives move
+- ``scatter_wire_lie``       -> GX-DTYPE-002        a ZeRO-style
+  reduce_scatter + all_gather pair accounted with the allreduce
+  convention (operand-once), hiding the (N-1)/N scatter and the
+  shard x (N-1) gather the chips actually send
 - ``dense_compressed_path``  -> GX-PURITY-001       a "compressed" path
   that decompresses to dense BEFORE the collective
 """
@@ -133,6 +137,42 @@ def _wire_accounting_lie() -> List[Finding]:
     return audit_wire_accounting(LyingFP16(), jnp.zeros((4096,)))
 
 
+def _scatter_wire_lie() -> List[Finding]:
+    """A ZeRO-style sharded reducer (psum_scatter the gradient, update
+    the shard, all_gather it back) whose accounting keeps the allreduce
+    operand-once convention.  At N=4 the chips really send
+    ``(N-1)/N * full`` for the scatter plus ``shard * (N-1)`` for the
+    gather — 1.5x what the accounting claims, the physical gap
+    ``collective_wire_bytes``'s per-chip convention now measures; the
+    audit's payload-convention diff sees the decomposition carry
+    ``full + shard`` = 1.25x the declared bytes and flags it at any
+    mesh width."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from geomx_tpu.analysis.passes import audit_wire_accounting
+    from geomx_tpu.compression.base import Compressor
+
+    n_axis = 4
+
+    class LyingScatter(Compressor):
+        name = "zero_scatter_lie"
+
+        def allreduce_leaf(self, g, state, axis_name, axis_size):
+            s = g.size // axis_size
+            shard = lax.psum_scatter(
+                g.reshape(-1).astype(jnp.float32).reshape(axis_size, s),
+                axis_name, scatter_dimension=0)
+            full = lax.all_gather(shard, axis_name).reshape(-1)
+            return full.reshape(g.shape).astype(g.dtype), state
+
+        def wire_bytes_leaf(self, leaf):
+            return leaf.size * 4  # the allreduce convention: a lie here
+
+    return audit_wire_accounting(LyingScatter(), jnp.zeros((4096,)),
+                                 num_parties=n_axis)
+
+
 def _dense_compressed_path() -> List[Finding]:
     """A BSC variant that decompresses each party's pairs to dense and
     THEN psums: the select/pack ran, but the WAN carries the full dense
@@ -171,6 +211,7 @@ CORPUS = (
     CorpusEntry("read_after_donate", "GX-DONATE-001", _read_after_donate),
     CorpusEntry("fp32_leak_bf16_path", "GX-DTYPE-001", _fp32_leak_bf16_path),
     CorpusEntry("wire_accounting_lie", "GX-DTYPE-002", _wire_accounting_lie),
+    CorpusEntry("scatter_wire_lie", "GX-DTYPE-002", _scatter_wire_lie),
     CorpusEntry("dense_compressed_path", "GX-PURITY-001",
                 _dense_compressed_path),
 )

@@ -452,18 +452,66 @@ def _traced_allreduce_jaxpr(compressor, params, num_parties: int = 2):
     return jax.make_jaxpr(fn)(stack(params), stack(state))
 
 
-def collective_wire_bytes(jaxpr) -> int:
+# scatter-family primitives whose per-chip bytes differ from the
+# "operand counts once" allreduce convention: a reduce_scatter
+# (lax.psum_scatter) sends (N-1)/N of its full-size operand per chip,
+# an all_gather forwards this chip's shard-size operand to N-1 peers.
+# Both carry the mesh width in eqn.params["axis_size"].
+_SCATTER_PRIMS = frozenset({"psum_scatter", "reduce_scatter"})
+_GATHER_PRIMS = frozenset({"all_gather", "all_gather_invariant"})
+
+
+def _collective_axis_size(eqn) -> Optional[int]:
+    n = eqn.params.get("axis_size")
+    try:
+        return int(n) if n else None
+    except (TypeError, ValueError):
+        return None
+
+
+def collective_wire_bytes(jaxpr, convention: str = "per_chip") -> int:
     """Bytes one participant puts on the wire per execution of the
     traced program, summed over its collectives' operands — the
     jaxpr-derived ground truth ``Compressor.wire_bytes`` must agree
-    with.  (Convention matches ``wire_bytes``: an all_gather/psum
-    operand counts once — what this party sends.)"""
-    total = 0
+    with.
+
+    ``convention="per_chip"`` (default) counts physical bytes each chip
+    sends per execution:
+
+    - ``psum`` family: the operand counts once — the party's payload,
+      the reference's ps-lite byte-counter convention;
+    - ``psum_scatter`` / ``reduce_scatter``: the chip keeps its own 1/N
+      shard, so it sends ``(N-1)/N`` of the full-size operand (the
+      allreduce convention hard-coded here before the ZeRO path would
+      overcount the kept shard);
+    - ``all_gather``: the operand is this chip's shard and travels to
+      every one of the N-1 peers, so it counts ``(N-1)`` times.
+
+    ``N`` comes from the equation's ``axis_size`` param; a collective
+    without one falls back to the operand-once convention.
+
+    ``convention="payload"`` counts every collective operand exactly
+    once — the N-independent per-party *contribution* convention that
+    ``Compressor.wire_bytes`` declares (a psum's ring factor and a
+    gather's (N-1) fan-out are transport properties, not payload)."""
+    if convention not in ("per_chip", "payload"):
+        raise ValueError(f"unknown wire-byte convention {convention!r}")
+    total = 0.0
     for site in walk_jaxpr(jaxpr):
-        if site.primitive in COLLECTIVE_PRIMS:
-            total += sum(aval_bytes(v.aval) for v in site.eqn.invars
-                         if hasattr(v, "aval"))
-    return total
+        if site.primitive not in COLLECTIVE_PRIMS:
+            continue
+        opb = sum(aval_bytes(v.aval) for v in site.eqn.invars
+                  if hasattr(v, "aval"))
+        n = _collective_axis_size(site.eqn)
+        if convention == "payload":
+            total += opb
+        elif n and site.primitive in _SCATTER_PRIMS:
+            total += opb * (n - 1) / n
+        elif n and site.primitive in _GATHER_PRIMS:
+            total += opb * (n - 1)
+        else:
+            total += opb
+    return int(round(total))
 
 
 def audit_wire_accounting(compressor, params, num_parties: int = 2,
@@ -474,9 +522,18 @@ def audit_wire_accounting(compressor, params, num_parties: int = 2,
     that under-reports hides wire cost from every telemetry consumer
     (``dc_compression_ratio``, byte counters, bench records); one that
     hardcodes fp32 for a 16-bit wire inflates it 2x.  Tolerances absorb
-    lane padding (``abs_tol`` per program) and rounding."""
+    lane padding (``abs_tol`` per program) and rounding.
+
+    The diff runs under the *payload* convention (each collective
+    operand once): ``wire_bytes`` documents the party's N-independent
+    contribution, and an all_gather-emulated allreduce (bsc/fp16/2bit)
+    fans that same payload to N-1 peers — per-chip counting would flag
+    every honest gather-based compressor at ``num_parties > 2``.  A
+    scatter+gather decomposition declared with the plain allreduce
+    convention still trips the gate: its traced payload is the full
+    operand plus the gathered shard, 1+1/N times the declared bytes."""
     jx = _traced_allreduce_jaxpr(compressor, params, num_parties)
-    traced = collective_wire_bytes(jx)
+    traced = collective_wire_bytes(jx, convention="payload")
     declared = int(compressor.wire_bytes(params))
     gap = abs(traced - declared)
     if gap <= abs_tol or gap <= rel_tol * max(traced, declared):
@@ -549,6 +606,61 @@ def _dense_floor_bytes(compressor, params) -> int:
                  getattr(compressor, "size_lower_bound", 1)))
     eligible = [leaf.size for leaf in leaves if leaf.size >= floor]
     return 4 * max(eligible) if eligible else 0
+
+
+def audit_zero_compressed_path(bucketed, params, num_shards: int,
+                               num_parties: int = 2) -> List[Finding]:
+    """GX-PURITY-001 for the ZeRO dc tier (train/zero.py): trace the
+    per-shard compressed allreduce (``BucketedCompressor.
+    allreduce_shards``) over a dc mesh and require every wire payload to
+    stay below the *shard*-dense floor — the shard path's stronger form
+    of the purity claim: not only does no bucket-dense intermediate
+    cross the wire, no chip even materializes one on the dc tier.
+    Dense inner compressors are skipped like :func:`audit_compressed_path`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from geomx_tpu.parallel.collectives import shard_map_compat
+    from geomx_tpu.topology import DC_AXIS
+
+    leaves = jax.tree.leaves(params)
+    bk = bucketed.zero_bucketer(leaves)
+    if not bk.bucket_sizes:
+        return []
+    shard_sizes = [n // num_shards for n in bk.bucket_sizes]
+    dense_shard = 4 * max(shard_sizes)
+    wire = int(bucketed.shard_wire_bytes(params, num_shards))
+    if wire >= 4 * sum(shard_sizes):
+        return []  # dense inner compressor: nothing to audit
+    devs = jax.devices()
+    if len(devs) < num_parties:
+        raise RuntimeError(
+            f"audit needs {num_parties} devices for the dc axis (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{num_parties})")
+    mesh = Mesh(np.array(devs[:num_parties]), (DC_AXIS,))
+    shards = [jnp.zeros((s,), jnp.float32) for s in shard_sizes]
+    state = bucketed.init_shard_state(params, num_shards)
+
+    def f(sh, ss):
+        sh = [a[0] for a in sh]
+        s = jax.tree.map(lambda a: a[0], ss)
+        out, s2 = bucketed.allreduce_shards(sh, s, DC_AXIS, num_parties,
+                                            bk)
+        return ([a[None] for a in out],
+                jax.tree.map(lambda a: a[None], s2))
+
+    fn = shard_map_compat(f, mesh, in_specs=(P(DC_AXIS), P(DC_AXIS)),
+                          out_specs=(P(DC_AXIS), P(DC_AXIS)))
+
+    def stack(t):
+        return jax.tree.map(
+            lambda a: jnp.stack([jnp.asarray(a)] * num_parties), t)
+
+    jx = jax.make_jaxpr(fn)(stack(shards), stack(state))
+    return PurityPass().run(jx, AuditContext(dense_bytes=dense_shard))
 
 
 def audit_compressed_path(compressor, params,

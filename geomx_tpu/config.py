@@ -85,6 +85,17 @@ class GeoConfig:
     # GEOMX_DCASGD_LAMBDA — 0.04 is the reference default strength)
     pipeline_dcasgd: float = 0.0
 
+    # ---- ZeRO-sharded weight update (train/zero.py, docs/api.md
+    # "Sharded weight update"): the bucketed dc-tier engine shards the
+    # optimizer over the worker axis — worker-tier reduce becomes
+    # psum_scatter on the fused buckets, each chip decompresses and
+    # updates only its 1/W bucket shard (optimizer + EF-residual state
+    # shrink ~1/W per chip), and one all_gather rebuilds params for the
+    # next forward.  Opt-in (GEOMX_ZERO=1); requires the bucketed engine
+    # (GEOMX_BUCKET_BYTES > 0) and sync_mode fsa or mixed (pipelined
+    # composes).  Planned TPU default once hardware parity lands.
+    zero: bool = False
+
     # ---- MultiGPS parameter sharding
     # tensors >= this many elements are sharded across the global-server axis
     # (reference MXNET_KVSTORE_BIGARRAY_BOUND, src/kvstore/kvstore_dist.h:69)
@@ -170,6 +181,7 @@ class GeoConfig:
             pipeline_depth=_env(["GEOMX_PIPELINE_DEPTH"], 0,
                                 lambda s: int(float(s))),
             pipeline_dcasgd=_env(["GEOMX_PIPELINE_DCASGD"], 0.0, float),
+            zero=_env_bool(["GEOMX_ZERO"], False),
             bigarray_bound=_env(
                 ["GEOMX_BIGARRAY_BOUND", "MXNET_KVSTORE_BIGARRAY_BOUND"],
                 1_000_000, int),

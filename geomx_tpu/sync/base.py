@@ -28,6 +28,25 @@ import jax
 import numpy as np
 
 
+def _private_dc_copy(dc_compressor):
+    """Shallow-copy a dc-tier compressor stack so ``bind_zero``'s
+    re-padding (pad_to, cached bucket layouts) lands on a private
+    instance: the caller's compressor may still back a replicated
+    baseline whose layout must not shift under it."""
+    import copy
+
+    from geomx_tpu.compression.bucketing import BucketedCompressor
+    from geomx_tpu.sync.pipeline import PipelinedCompressor
+    dc = copy.copy(dc_compressor)
+    bucketed = dc
+    if isinstance(dc, PipelinedCompressor):
+        dc.inner = copy.copy(dc.inner)
+        bucketed = dc.inner
+    if isinstance(bucketed, BucketedCompressor):
+        bucketed._bucketers = {}              # never share the layout cache
+    return dc
+
+
 class SyncAlgorithm(abc.ABC):
     name: str = "base"
 
@@ -52,6 +71,17 @@ class SyncAlgorithm(abc.ABC):
     # stale shard).
     live_parties: Optional[Tuple[bool, ...]] = None
     supports_degraded: bool = False
+
+    # ZeRO-sharded weight update (train/zero.py, GEOMX_ZERO): algorithms
+    # that can express their gradient sync on 1/W bucket shards —
+    # psum_scatter worker tier, per-shard compressed dc tier — opt in
+    # with supports_zero and implement sync_grad_shards.  None = the
+    # replicated update path.  Contract: shard-shaped dc-tier state
+    # MUST live under the "dc_comp" key of sync_state — the host-side
+    # layout handlers (host_zero_state/place_zero_state/
+    # reshard_zero_state) route shard-vs-replicated on that key.
+    zero_plan = None
+    supports_zero: bool = False
 
     def bind_topology(self, topology) -> "SyncAlgorithm":
         self.num_parties = topology.num_parties
@@ -101,6 +131,40 @@ class SyncAlgorithm(abc.ABC):
         from geomx_tpu.topology import DC_AXIS
         m = jnp.asarray(np.asarray(self.live_parties, np.float32))
         return m[lax.axis_index(DC_AXIS)]
+
+    # ---- ZeRO-sharded weight update (train/zero.py) ------------------------
+
+    def bind_zero(self, plan) -> "SyncAlgorithm":
+        """Return a copy of this algorithm bound to a
+        :class:`~geomx_tpu.train.zero.ZeroPlan` (GEOMX_ZERO): the
+        gradient sync switches to the bucket-shard form and the dc-tier
+        state becomes shard-shaped.  NEVER mutates ``self`` — binding
+        re-pads the dc compressor's bucket layout, and a handed-in
+        algorithm may also serve as a replicated baseline (the same
+        contract as ``PipelinedSync``'s shallow copy).  Algorithms whose
+        aggregation has no shard form (HFA's milestone algebra lives in
+        parameter space) reject loudly."""
+        if not self.supports_zero:
+            raise ValueError(
+                f"sync algorithm {self.name!r} does not support the "
+                "ZeRO-sharded weight update (GEOMX_ZERO): its "
+                "aggregation has no bucket-shard form (FSA, MixedSync "
+                "and PipelinedSync do)")
+        import copy
+        bound = copy.copy(self)
+        bound.dc_compressor = _private_dc_copy(self.dc_compressor)
+        plan.bind_compressor(bound.dc_compressor)
+        bound.zero_plan = plan
+        return bound
+
+    def sync_grad_shards(self, grads: Any, params: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
+        """ZeRO gradient sync: return (list of global-mean flat bucket
+        *shards* — this worker's 1/W slice of every fused bucket — and
+        the new sync state).  Only called when a zero plan is bound."""
+        raise NotImplementedError(
+            f"{self.name!r} bound a zero plan but implements no "
+            "sync_grad_shards")
 
     def reset_comm_state(self, params: Any, state: Any,
                          policy: str = "reset") -> Any:
@@ -166,11 +230,21 @@ class SyncAlgorithm(abc.ABC):
             leaves = jax.tree.leaves(params)
             dense = float(sum(
                 leaf.size * np.dtype(leaf.dtype).itemsize for leaf in leaves))
-            wire = float(dc.wire_bytes(params))
+            if self.zero_plan is not None:
+                # ZeRO (train/zero.py): per-chip dc payload is the
+                # compressed 1/W bucket shard; the worker tier's
+                # scatter/gather bytes ride along so telemetry sees the
+                # full decomposition
+                out.update(self.zero_plan.wire_accounting(params))
+                wire = out.get("dc_wire_bytes", 0.0)
+                # the per-party dense baseline shrinks with the shard too
+                dense = dense / self.zero_plan.W
+            else:
+                wire = float(dc.wire_bytes(params))
             out["dc_wire_bytes"] = wire
             out["dc_dense_bytes"] = dense
             out["dc_compression_ratio"] = dense / wire if wire else 1.0
         wc = getattr(self, "worker_compressor", None)
-        if wc is not None:
+        if wc is not None and self.zero_plan is None:
             out["worker_wire_bytes"] = float(wc.wire_bytes(params))
         return out

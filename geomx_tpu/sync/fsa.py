@@ -38,6 +38,7 @@ class FSA(SyncAlgorithm):
     name = "fsa"
     supports_degraded = True  # renormalized survivor mean (resilience/)
     grads_replicated_after_sync = True  # hierarchical psum output
+    supports_zero = True  # bucket-shard form of the same hierarchy
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
                  worker_compressor: Optional[Compressor] = None,
@@ -53,9 +54,18 @@ class FSA(SyncAlgorithm):
                                             bucket_bytes)
         self.worker_compressor = worker_compressor or NoCompressor()
 
+    def _dc_init(self, params: Any) -> Any:
+        """dc-tier compressor state: shard-shaped under a bound ZeRO
+        plan (EF residuals live on this worker's 1/W bucket slice),
+        bucket/leaf-shaped otherwise."""
+        if self.zero_plan is not None:
+            return self.dc_compressor.init_shard_state(params,
+                                                       self.zero_plan.W)
+        return self.dc_compressor.init_state(params)
+
     def init_state(self, params: Any, model_state: Any = None) -> Any:
         return {
-            "dc_comp": self.dc_compressor.init_state(params),
+            "dc_comp": self._dc_init(params),
             "worker_comp": self.worker_compressor.init_state(params),
         }
 
@@ -81,6 +91,40 @@ class FSA(SyncAlgorithm):
         if nl > 1:
             g = jax.tree.map(lambda x: x / nl, g)
         return g, {"dc_comp": dstate, "worker_comp": wstate}
+
+    def sync_grad_shards(self, grads: Any, params: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
+        """ZeRO form of :meth:`sync_grads` (train/zero.py): the same
+        two-tier hierarchy on 1/W bucket shards —
+
+            worker tier: psum_scatter(flat buckets) / W   (ICI)
+            dc tier:     compressed allreduce per SHARD   (DCN)
+
+        Each chip compresses, transfers, decompresses and (in
+        train/step.py) updates only its contiguous shard of every fused
+        bucket; the degraded-membership renormalization applies on the
+        shards with the identical survivor-mean algebra.  Returns the
+        list of global-mean bucket shards, not a gradient tree."""
+        plan = self.zero_plan
+        leaves = jax.tree.leaves(grads)
+        bk = self.dc_compressor.zero_bucketer(leaves)
+        # worker tier: the scatter IS the reduce (and a 1/W wire saving
+        # per ICI link); a configured worker compressor is bypassed —
+        # build_train_step warns, mirroring MultiGPS
+        shards = [plan.scatter_bucket(b, WORKER_AXIS)
+                  for b in bk.flatten(leaves)]
+        w = self.party_weight()
+        if w is not None:
+            # degraded mode: identical exclusion algebra to sync_grads,
+            # applied shard-wise — a dead party's shard zeroes before
+            # the collective and the mean renormalizes over survivors
+            shards = [x * w for x in shards]
+        shards, dstate = self.dc_compressor.allreduce_shards(
+            shards, state["dc_comp"], DC_AXIS, self.num_parties, bk)
+        nl = self.num_live
+        if nl > 1:
+            shards = [x / nl for x in shards]
+        return shards, dict(state, dc_comp=dstate)
 
     def sync_model_state(self, model_state: Any, state: Any,
                          step: jax.Array) -> Tuple[Any, Any]:
@@ -108,7 +152,7 @@ class FSA(SyncAlgorithm):
         state = super().reset_comm_state(params, state, policy)
         if policy == "carry":
             return state
-        return dict(state, dc_comp=self.dc_compressor.init_state(params))
+        return dict(state, dc_comp=self._dc_init(params))
 
     def telemetry_scalars(self, state: Any) -> dict:
         """EF-residual magnitude of the dc-tier compressor state (the

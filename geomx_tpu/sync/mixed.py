@@ -39,6 +39,7 @@ class MixedSync(SyncAlgorithm):
     name = "mixed"
     supports_degraded = True  # renormalized survivor mean (resilience/)
     grads_replicated_after_sync = True  # hierarchical psum output
+    supports_zero = True  # bucket-shard form (train/zero.py)
 
     def __init__(self, dc_compressor: Optional[Compressor] = None,
                  pull_interval: int = 1, dcasgd_lambda: float = 0.0,
@@ -53,10 +54,19 @@ class MixedSync(SyncAlgorithm):
         self.pull_interval = int(pull_interval)
         self.dcasgd_lambda = float(dcasgd_lambda)
 
+    def _dc_init(self, params: Any) -> Any:
+        if self.zero_plan is not None:
+            return self.dc_compressor.init_shard_state(params,
+                                                       self.zero_plan.W)
+        return self.dc_compressor.init_state(params)
+
     def init_state(self, params: Any, model_state: Any = None) -> Any:
+        # the stale pull copy stays FULL and replicated even under ZeRO:
+        # it is what the forward pass runs at (forward_params), not an
+        # update-side buffer
         return {
             "stale": jax.tree.map(jnp.asarray, params),
-            "dc_comp": self.dc_compressor.init_state(params),
+            "dc_comp": self._dc_init(params),
         }
 
     def forward_params(self, params: Any, state: Any) -> Any:
@@ -89,6 +99,35 @@ class MixedSync(SyncAlgorithm):
         state = dict(state, dc_comp=dstate)
         return grads, state
 
+    def sync_grad_shards(self, grads: Any, params: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
+        """ZeRO form of :meth:`sync_grads` (train/zero.py): worker-tier
+        psum_scatter on the fused buckets, DCASGD compensation computed
+        shard-wise against this worker's slice of the true and stale
+        weights (both replicated, so the slice is free), then the
+        per-shard compressed dc tier with the survivor-mean algebra."""
+        plan = self.zero_plan
+        leaves = jax.tree.leaves(grads)
+        bk = self.dc_compressor.zero_bucketer(leaves)
+        shards = [plan.scatter_bucket(b, WORKER_AXIS)
+                  for b in bk.flatten(leaves)]
+        if self.dcasgd_lambda > 0.0:
+            lam = self.dcasgd_lambda
+            widx = lax.axis_index(WORKER_AXIS)
+            p_sh = plan.tree_shards(params, bk, widx)
+            s_sh = plan.tree_shards(state["stale"], bk, widx)
+            shards = [g + lam * g * g * (w - ws)
+                      for g, w, ws in zip(shards, p_sh, s_sh)]
+        w = self.party_weight()
+        if w is not None:
+            shards = [g * w for g in shards]
+        shards, dstate = self.dc_compressor.allreduce_shards(
+            shards, state["dc_comp"], DC_AXIS, self.num_parties, bk)
+        nl = self.num_live
+        if nl > 1:
+            shards = [g / nl for g in shards]
+        return shards, dict(state, dc_comp=dstate)
+
     def sync_params(self, params: Any, state: Any,
                     step: jax.Array) -> Tuple[Any, Any]:
         # the asynchronous pull: refresh the stale copy every pull_interval
@@ -120,7 +159,7 @@ class MixedSync(SyncAlgorithm):
         state = super().reset_comm_state(params, state, policy)
         if policy == "carry":
             return state
-        return dict(state, dc_comp=self.dc_compressor.init_state(params))
+        return dict(state, dc_comp=self._dc_init(params))
 
     def telemetry_scalars(self, state: Any) -> dict:
         """EF residual magnitude plus the staleness gap: the distance

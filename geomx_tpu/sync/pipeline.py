@@ -138,6 +138,55 @@ class PipelinedCompressor(Compressor):
             "PipelinedCompressor is tree-level (the in-flight buffer "
             "spans the whole gradient); per-leaf state is not supported")
 
+    def init_shard_state(self, grads: Any, num_shards: int) -> Any:
+        """ZeRO (train/zero.py): the in-flight double-buffer holds 1/W
+        bucket *shards* — the aggregate parked between launch and apply
+        shrinks with the worker axis exactly like the optimizer state."""
+        if not self._bucketed:
+            raise ValueError(
+                "GEOMX_ZERO requires the bucketed dc-tier engine under "
+                "the pipelined compressor (GEOMX_BUCKET_BYTES > 0)")
+        leaves = jax.tree.leaves(grads)
+        bk = self.inner._bucketer(leaves)
+        inflight = [jnp.zeros((n // num_shards,), jnp.float32)
+                    for n in bk.bucket_sizes]
+        return {"inflight": inflight,
+                "inner": self.inner.init_shard_state(grads, num_shards)}
+
+    def zero_bucketer(self, leaves):
+        return self.inner.zero_bucketer(leaves)
+
+    def allreduce_shards(self, shards, state: Any, axis_name: str,
+                         axis_size: int, bk) -> Tuple[List[jax.Array], Any]:
+        """Double-buffered ZeRO dc tier: launch this step's per-shard
+        compressed collectives, return the PREVIOUS step's completed
+        shard aggregates — staleness-1 on shard-sized in-flight
+        buffers."""
+        prev = state["inflight"]
+        # tier boundary, same contract as the replicated path: pin the
+        # scattered party-mean shards as one unit before the DCN launch
+        shards = list(lax.optimization_barrier(tuple(shards)))
+        payload = sum(
+            self.inner.inner.wire_bytes_leaf(
+                jax.ShapeDtypeStruct((int(b.size),), jnp.float32))
+            for b in shards)
+        with profile_scope(f"{axis_name}_pipeline/launch",
+                           category="comm",
+                           args={"buckets": bk.num_buckets,
+                                 "payload_bytes": payload}):
+            launched, inner_state = self.inner.allreduce_shards(
+                shards, state["inner"], axis_name, axis_size, bk)
+        with profile_scope(f"{axis_name}_pipeline/apply", category="comm"):
+            out = list(prev)
+        return out, {"inflight": launched, "inner": inner_state}
+
+    def peek_shards(self, state: Any) -> Tuple[List[jax.Array], Any]:
+        """The completed in-flight shard aggregates plus state with the
+        buffer zeroed — the ZeRO drain path."""
+        prev = state["inflight"]
+        zeroed = [jnp.zeros_like(b) for b in prev]
+        return list(prev), dict(state, inflight=zeroed)
+
     # -- the double-buffered all-reduce --------------------------------------
     def allreduce(self, grads: Any, state: Any, axis_name: str,
                   axis_size: int) -> Tuple[Any, Any]:
@@ -263,6 +312,54 @@ class PipelinedSync(SyncAlgorithm):
         self.inner.bind_topology(topology)
         return self
 
+    # -- ZeRO-sharded weight update (train/zero.py) --------------------------
+    supports_zero = True
+
+    def bind_zero(self, plan) -> "PipelinedSync":
+        """Bind the ZeRO plan through to the wrapped algorithm: the
+        inner FSA/MixedSync owns the shard-form sync, and the pipelined
+        compressor double-buffers shard-sized in-flight aggregates.
+        DCASGD staleness compensation is rejected: the correction term
+        needs the previous step's weights at this worker's shard, and
+        the host-side state init cannot address a per-worker slice — a
+        full prev-params copy would forfeit the 1/W memory win the mode
+        exists for."""
+        if self.dcasgd_lambda > 0.0:
+            raise ValueError(
+                "GEOMX_ZERO does not compose with GEOMX_PIPELINE_DCASGD: "
+                "the compensation's prev-params copy has no shard-local "
+                "form; disable one of the two")
+        # copy-bind, like the base contract: the caller's pipelined
+        # instance may still drive a replicated run
+        bound = copy.copy(self)
+        bound.inner = self.inner.bind_zero(plan)
+        bound.zero_plan = plan
+        return bound
+
+    def sync_grad_shards(self, grads: Any, params: Any, state: Any,
+                         step: jax.Array) -> Tuple[Any, Any]:
+        # the wrapped algorithm runs its shard-form sync; its dc-tier
+        # compressor is pipelined, so the returned shards are the
+        # PREVIOUS step's completed aggregates (already tier-divided)
+        shards, inner_state = self.inner.sync_grad_shards(
+            grads, params, state["inner"], step)
+        return shards, dict(state, inner=inner_state)
+
+    def drain_grad_shards(self, params: Any,
+                          state: Any) -> Tuple[List[jax.Array], Any]:
+        """ZeRO drain: the completed in-flight shard aggregates,
+        tier-divided exactly as sync_grad_shards would have, with the
+        buffer zeroed.  No collectives — Trainer.drain_pipeline's
+        sharded program still runs the all_gather that rebuilds
+        params."""
+        comp = self.inner.dc_compressor
+        shards, dc_state = comp.peek_shards(state["inner"]["dc_comp"])
+        nl = self.num_live
+        if nl > 1:
+            shards = [g / nl for g in shards]
+        return shards, dict(state,
+                            inner=dict(state["inner"], dc_comp=dc_state))
+
     # -- membership (degraded-mode WAN sync, resilience/) --------------------
     def bind_membership(self, mask) -> "PipelinedSync":
         # the inner algorithm owns the masked renormalized mean; this
@@ -285,9 +382,7 @@ class PipelinedSync(SyncAlgorithm):
         s = SyncAlgorithm.reset_comm_state(self, params, state, policy)
         if policy == "carry":
             return s
-        inner_state = dict(s["inner"],
-                           dc_comp=self.inner.dc_compressor.init_state(
-                               params))
+        inner_state = dict(s["inner"], dc_comp=self.inner._dc_init(params))
         return dict(s, inner=inner_state)
 
     # -- state ---------------------------------------------------------------

@@ -4,6 +4,8 @@ from geomx_tpu.train.state import TrainState, replicate_tree, unreplicate_tree
 from geomx_tpu.train.step import (build_eval_step, build_train_step,
                                   make_loss_fn)
 from geomx_tpu.train.trainer import Trainer
+from geomx_tpu.train.zero import ZeroPlan
 
-__all__ = ["TrainState", "replicate_tree", "unreplicate_tree",
-           "build_train_step", "build_eval_step", "make_loss_fn", "Trainer"]
+__all__ = ["TrainState", "ZeroPlan", "replicate_tree",
+           "unreplicate_tree", "build_train_step", "build_eval_step",
+           "make_loss_fn", "Trainer"]

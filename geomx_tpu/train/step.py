@@ -168,6 +168,59 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                 f"compressor ({sync.worker_compressor.name}); it still "
                 "applies to smaller leaves", stacklevel=2)
 
+    zplan = None
+    if config is not None and getattr(config, "zero", False):
+        from geomx_tpu.compression.base import NoCompressor
+        from geomx_tpu.train.zero import ZeroPlan
+        if mgps is not None:
+            # fail loudly (same contract as the other composition
+            # checks): both modes shard the weight update — MultiGPS
+            # per-leaf, ZeRO per-bucket — and stacking them would shard
+            # a shard
+            raise ValueError(
+                "GEOMX_ZERO does not compose with GEOMX_MULTI_GPS: both "
+                "shard the weight update over the worker axis (ZeRO per "
+                "fused bucket, MultiGPS per big leaf); pick one")
+        zplan = getattr(sync, "zero_plan", None)
+        if zplan is None:
+            # rejects HFA (no shard form) and a non-bucketed dc engine,
+            # and re-aligns the bucket padding so every bucket splits
+            # into W lane-aligned shards (must happen before the first
+            # trace).  bind_zero returns a bound COPY — the caller's
+            # instance is never mutated; the Trainer binds up front and
+            # passes the bound algorithm in, so its membership
+            # recompiles land here with the plan already attached and
+            # reuse it instead of re-binding per mask
+            zplan = ZeroPlan(topology.workers_per_party)
+            sync = sync.bind_zero(zplan)
+        wc = getattr(sync, "worker_compressor",
+                     getattr(getattr(sync, "inner", None),
+                             "worker_compressor", None))
+        if wc is not None and not isinstance(wc, NoCompressor):
+            import warnings
+            # the worker-tier reduce IS the psum_scatter (already a 1/W
+            # wire saving per ICI link); a configured worker compressor
+            # never runs — same contract as MultiGPS's big leaves
+            warnings.warn(
+                "GEOMX_ZERO: the worker-tier reduce is the bucket "
+                "psum_scatter; the configured worker compressor "
+                f"({wc.name}) is bypassed", stacklevel=2)
+
+    def _zero_sync_update(grads, params, opt_state, sync_state, step):
+        """ZeRO (train/zero.py): reduce-scatter compressed buckets ->
+        shard-local optimizer -> all_gather params.  The optimizer (and
+        its state, allocated shard-shaped by Trainer.init_state) sees
+        flat 1/W bucket shards; one all_gather per bucket rebuilds the
+        replicated params for the next forward."""
+        shard_g, sync_state = sync.sync_grad_shards(grads, params,
+                                                    sync_state, step)
+        params, opt_state = zplan.apply_shard_update(
+            tx, shard_g, params, opt_state, WORKER_AXIS)
+        # param-space hook still runs on the rebuilt replicated params
+        # (MixedSync's stale-pull refresh)
+        params, sync_state = sync.sync_params(params, sync_state, step)
+        return params, opt_state, sync_state
+
     def _mgps_sync_update(grads, params, opt_state, sync_state, step):
         """MultiGPS: hierarchical reduce + optimizer with big leaves
         sharded 1/W across the worker axis (reference placement:
@@ -273,6 +326,13 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         with probe_ctx as inline_sink:
             if mgps is not None:
                 params, opt_state, sync_state = _mgps_sync_update(
+                    grads, params, opt_state, sync_state, step)
+            elif zplan is not None:
+                # ZeRO: sync+update fuse like MultiGPS, and the synced
+                # gradient exists only as this worker's shard — the
+                # replicated-value probes are skipped rather than
+                # misreporting one shard under a replicated out-spec
+                params, opt_state, sync_state = _zero_sync_update(
                     grads, params, opt_state, sync_state, step)
             else:
                 grads, sync_state = sync.sync_grads(grads, params,

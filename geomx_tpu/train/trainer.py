@@ -61,6 +61,25 @@ class Trainer:
                 RuntimeWarning, stacklevel=2)
         self._sp_model = sp_model
         self._donate = donate
+        # ZeRO-sharded weight update (train/zero.py, GEOMX_ZERO): bind
+        # the plan HERE, onto the bound copy bind_zero returns, so the
+        # trainer's own sync carries it (shard-shaped state init, the
+        # sharded drain program, checkpoint/catch-up layout) and
+        # build_train_step — including every membership recompile —
+        # reuses one plan.  The caller's sync instance is never mutated.
+        if getattr(self.config, "zero", False):
+            if getattr(self.sync, "supports_zero", False) \
+                    and self.sync.zero_plan is None:
+                from geomx_tpu.train.zero import ZeroPlan
+                self.sync = self.sync.bind_zero(
+                    ZeroPlan(topology.workers_per_party))
+        elif getattr(self.sync, "zero_plan", None) is not None:
+            raise ValueError(
+                "sync algorithm is ZeRO-bound (zero_plan set) but this "
+                "trainer's config has zero=False: the step program would "
+                "run the replicated update against shard-shaped sync "
+                "state.  Pass a fresh (unbound) sync algorithm, or "
+                "enable GEOMX_ZERO/GeoConfig(zero=True) to match")
         self.train_step = build_train_step(
             self.loss_fn, self.tx, self.sync, topology, self.mesh,
             donate=donate, config=self.config, sp_model=sp_model)
@@ -77,6 +96,12 @@ class Trainer:
             from geomx_tpu.parallel.multigps import MultiGPSPlan
             self._mgps = MultiGPSPlan(self.config.bigarray_bound,
                                       topology.workers_per_party)
+        # ZeRO-sharded weight update (train/zero.py, GEOMX_ZERO):
+        # build_train_step bound the plan into the sync algorithm; the
+        # Trainer needs it for shard-shaped state init, the sharded
+        # drain program, and checkpoint/catch-up layout handling
+        self._zero_plan = getattr(self.sync, "zero_plan", None)
+        self._memory_gauge_published = False
         self.eval_step, self._logits_fn = build_eval_step(
             self._sd_model.apply)
         self._batch_sharding = topology.batch_sharding(self.mesh)
@@ -145,6 +170,16 @@ class Trainer:
                 sync_state = dict(sync_state, dc_comp={
                     "sharded": dc.init_state(big),
                     "replicated": dc.init_state(small)})
+        elif self._zero_plan is not None:
+            # ZeRO: the optimizer runs on flat 1/W bucket shards, so its
+            # state is allocated shard-shaped — the per-chip memory
+            # saving IS this allocation.  The sync algorithm's zero-
+            # aware init sizes the dc-tier EF residuals the same way.
+            shards = self._zero_plan.shard_example(
+                params, self._zero_plan.bucketed)
+            opt_state = self.tx.init(shards)
+            sync_state = self.sync.init_state(params,
+                                              model_state=model_state)
         else:
             opt_state = self.tx.init(params)
             sync_state = self.sync.init_state(params,
@@ -259,9 +294,19 @@ class Trainer:
         # both close over the previous membership's traced program
         self._epoch_runners.clear()
         self._drain_step = None
+        if self._zero_plan is not None and policy == "carry":
+            # ZeRO + carry: the dc-tier state holds per-WORKER shard
+            # content, which the (0, 0)-copy round trip below would
+            # silently broadcast over every worker slot.  Carry is an
+            # identity on sync state for every membership-capable
+            # algorithm, so keep the device arrays untouched.
+            return state
         # residual/buffer policy, applied host-side on copy (0, 0) and
         # re-replicated (sync state is identical across replicas for
-        # every membership-capable algorithm)
+        # every membership-capable algorithm; under ZeRO the reset
+        # branch replaces the only worker-distinct subtree — dc_comp —
+        # with freshly-initialized shard-shaped zeros, which broadcast
+        # correctly)
         new_ss = self.sync.reset_comm_state(
             unreplicate_tree(state.params),
             unreplicate_tree(state.sync_state), policy)
@@ -330,8 +375,14 @@ class Trainer:
         full TrainState (params, optimizer, model state AND sync state),
         serialized in the checkpoint tree format — what the surviving
         parties broadcast to a returning party before
-        ``apply_membership`` widens the collective back over it."""
+        ``apply_membership`` widens the collective back over it.  Under
+        ZeRO the shard-bearing fields keep the full worker axis (shard
+        content differs per worker slot by design; copy (0, 0) would
+        hand the returning party W copies of worker 0's shard)."""
         from geomx_tpu.resilience.liveness import pack_catchup
+        if self._zero_plan is not None:
+            from geomx_tpu.train.zero import host_zero_state
+            return pack_catchup(host_zero_state(state))
         return pack_catchup(TrainState(
             step=np.asarray(jax.device_get(state.step)),
             params=unreplicate_tree(state.params),
@@ -343,10 +394,13 @@ class Trainer:
         """Install a catch-up payload as this process's authoritative
         state (the returning party's half of the protocol): the inverse
         of :meth:`catchup_payload`, re-replicated with the same
-        placement ``init_state`` uses."""
+        placement ``init_state`` uses (shard-aware under ZeRO)."""
         from jax.sharding import NamedSharding, PartitionSpec
         from geomx_tpu.resilience.liveness import unpack_catchup
         t = unpack_catchup(payload)
+        if self._zero_plan is not None:
+            from geomx_tpu.train.zero import place_zero_state
+            return place_zero_state(t, self.topology, self.mesh)
         return TrainState(
             step=jax.device_put(jnp.asarray(t.step),
                                 NamedSharding(self.mesh, PartitionSpec())),
@@ -356,6 +410,69 @@ class Trainer:
                                        self.mesh),
             sync_state=replicate_tree(t.sync_state, self.topology,
                                       self.mesh))
+
+    # ---- checkpointing (sharded-state aware; docs/api.md) ------------------
+
+    def checkpoint_meta(self) -> dict:
+        """The meta block a checkpoint of this trainer's state carries:
+        whether the state is ZeRO-sharded and the topology it was
+        sharded over, so :meth:`load_checkpoint` can re-shard onto a
+        different worker count and reject a GEOMX_ZERO mismatch."""
+        from geomx_tpu.train.zero import zero_checkpoint_meta
+        return zero_checkpoint_meta(self._zero_plan, self.topology)
+
+    def save_checkpoint(self, path: str, state: TrainState,
+                        step=None) -> str:
+        """Save ``state`` with this trainer's layout meta.  The device
+        arrays keep their full ``[P, W, ...]`` replica axes, so a
+        ZeRO run's per-worker shards are all captured (restoring onto
+        the same topology is bit-exact, including mid-pipeline
+        buffers)."""
+        from geomx_tpu.utils.checkpoint import save_checkpoint
+        return save_checkpoint(path, state, step=step,
+                               meta=self.checkpoint_meta())
+
+    def load_checkpoint(self, path: str, template: TrainState) -> TrainState:
+        """Restore a checkpoint into this trainer.
+
+        ``template`` is a state with this trainer's structure and
+        placements (fresh ``init_state`` output).  Rules:
+
+        - the checkpoint's ZeRO flag must match this trainer's
+          ``GEOMX_ZERO`` — a sharded optimizer cannot be installed into
+          a replicated update (or vice versa) and the mismatch raises
+          with the fix spelled out;
+        - same topology: leaves re-place directly (bit-exact resume,
+          mid-pipeline buffers included);
+        - different worker count (e.g. saved on 2x4, restored onto
+          2x2): shard-bearing leaves are gathered into full flat
+          buckets and re-split for the new worker axis
+          (train/zero.py ``reshard_zero_state``)."""
+        from geomx_tpu.utils.checkpoint import load_checkpoint
+        host_state, meta = load_checkpoint(path, with_meta=True)
+        ck_zero = bool((meta or {}).get("zero", False))
+        if ck_zero != (self._zero_plan is not None):
+            have = "GEOMX_ZERO=1" if ck_zero else "GEOMX_ZERO=0 (replicated)"
+            want = "GEOMX_ZERO=1" if self._zero_plan is not None \
+                else "GEOMX_ZERO=0 (replicated)"
+            raise ValueError(
+                f"checkpoint at {path!r} was saved with {have} but this "
+                f"trainer runs {want}: the optimizer-state layouts are "
+                "incompatible (sharded flat buckets vs replicated "
+                "leaves).  Restore with a matching GEOMX_ZERO setting, "
+                "or re-save from a trainer in the target mode")
+        topo_meta = (int((meta or {}).get("num_parties",
+                                          self.topology.num_parties)),
+                     int((meta or {}).get("workers_per_party",
+                                          self.topology.workers_per_party)))
+        here = (self.topology.num_parties, self.topology.workers_per_party)
+        if not ck_zero or topo_meta == here:
+            # same layout: direct re-placement onto the template's
+            # shardings (bit-exact)
+            from geomx_tpu.utils.checkpoint import place_like
+            return place_like(host_state, template)
+        from geomx_tpu.train.zero import reshard_zero_state
+        return reshard_zero_state(host_state, template, self.mesh)
 
     def drain_pipeline(self, state: TrainState) -> TrainState:
         """Apply a pipelined sync algorithm's completed in-flight dc-tier
@@ -374,8 +491,10 @@ class Trainer:
             return state
         if self._drain_step is None:
             from geomx_tpu.parallel.collectives import shard_map_compat
+            from geomx_tpu.topology import WORKER_AXIS
             from geomx_tpu.train.state import state_specs
             tx = self.tx
+            zplan = self._zero_plan
 
             def _drain(st):
                 def squeeze(t):
@@ -387,10 +506,22 @@ class Trainer:
                 opt_state = squeeze(st.opt_state)
                 model_state = squeeze(st.model_state)
                 sync_state = squeeze(st.sync_state)
-                # no collectives: the buffers already hold reduced values
-                g, sync_state = sync.drain_grads(params, sync_state)
-                updates, opt_state = tx.update(g, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                if zplan is not None:
+                    # ZeRO drain: apply the parked shard aggregates to
+                    # this worker's param shards, then the same
+                    # all_gather the step runs rebuilds full params —
+                    # the buffers hold reduced values, so the gather is
+                    # the drain's only collective
+                    g_sh, sync_state = sync.drain_grad_shards(params,
+                                                              sync_state)
+                    params, opt_state = zplan.apply_shard_update(
+                        tx, g_sh, params, opt_state, WORKER_AXIS)
+                else:
+                    # no collectives: the buffers already hold reduced
+                    # values
+                    g, sync_state = sync.drain_grads(params, sync_state)
+                    updates, opt_state = tx.update(g, opt_state, params)
+                    params = optax.apply_updates(params, updates)
                 model_state, sync_state = sync.drain_model_state(
                     model_state, sync_state)
                 return TrainState(step=st.step, params=expand(params),
@@ -458,11 +589,92 @@ class Trainer:
             reg.gauge("geomx_bucket_pad_fraction",
                       "Lane-padding waste in the bucket layout").set(
                 layout["pad_fraction"])
+            if self._zero_plan is not None:
+                # ZeRO bucket-shard layout: what one chip actually owns
+                # (the memory claim's denominator, scraped instead of
+                # bench-only)
+                w = self._zero_plan.W
+                reg.gauge("geomx_zero_workers",
+                          "Worker-axis width the weight update is "
+                          "sharded over").set(w)
+                reg.gauge("geomx_zero_shard_elems",
+                          "Flat bucket elements owned per chip under "
+                          "the ZeRO-sharded update").set(
+                    layout["padded_elems"] / w)
+        if self._zero_plan is not None:
+            reg.gauge("geomx_zero_enabled",
+                      "1 when the ZeRO-sharded weight update is "
+                      "active").set(1.0)
         if self._event_log is not None:
             self._event_log.emit("step_probes", iteration=iteration,
                                  **flat)
         else:
             log_event("step_probes", iteration=iteration, **flat)
+
+    def step_memory_stats(self, state: TrainState, xb, yb):
+        """Compiled-step memory accounting from XLA's
+        ``compiled.memory_analysis()`` — the measured source for the
+        ``geomx_step_memory_bytes`` gauge and bench ``--compare-zero``'s
+        memory claim.  Adds the sharded-state accounting (bytes of
+        optimizer + sync state one chip holds, from the placed arrays'
+        shapes) so the 1/W claim is checkable even where the backend
+        offers no analysis object."""
+        n_dev = max(1, len(self.mesh.devices.reshape(-1)))
+
+        def _per_chip_bytes(tree):
+            return sum(leaf.size * leaf.dtype.itemsize
+                       for leaf in jax.tree.leaves(tree)
+                       if hasattr(leaf, "size")) / n_dev
+
+        out = {
+            "opt_state_bytes_per_chip": _per_chip_bytes(state.opt_state),
+            "sync_state_bytes_per_chip": _per_chip_bytes(state.sync_state),
+            "params_bytes_per_chip": _per_chip_bytes(state.params),
+        }
+        try:
+            ma = self.train_step.lower(state, xb, yb).compile() \
+                .memory_analysis()
+        except Exception as e:  # backend without AOT memory stats
+            out["memory_analysis"] = {"unavailable": repr(e)}
+            return out
+        if ma is None:
+            out["memory_analysis"] = {"unavailable": "None"}
+            return out
+        fields = {}
+        for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            if hasattr(ma, k):
+                fields[k] = int(getattr(ma, k))
+        fields["step_memory_bytes"] = (
+            fields.get("temp_size_in_bytes", 0)
+            + fields.get("argument_size_in_bytes", 0)
+            + fields.get("output_size_in_bytes", 0))
+        out["memory_analysis"] = fields
+        return out
+
+    def publish_memory_metrics(self, state: TrainState, xb, yb) -> None:
+        """Publish the per-chip step-memory gauges (telemetry plane;
+        once per trainer — the program is static).  One extra AOT
+        lower+compile; only runs when telemetry is enabled."""
+        if self._memory_gauge_published:
+            return
+        self._memory_gauge_published = True
+        from geomx_tpu.telemetry import get_registry
+        stats = self.step_memory_stats(state, xb, yb)
+        reg = get_registry()
+        fam = reg.gauge("geomx_step_memory_bytes",
+                        "Per-chip training-step memory by component",
+                        ("component",))
+        for comp in ("opt_state_bytes_per_chip",
+                     "sync_state_bytes_per_chip",
+                     "params_bytes_per_chip"):
+            fam.labels(component=comp.replace("_bytes_per_chip", "")) \
+                .set(float(stats[comp]))
+        ma = stats.get("memory_analysis", {})
+        if "step_memory_bytes" in ma:
+            fam.labels(component="compiled_step").set(
+                float(ma["step_memory_bytes"]))
 
     def predict_logits(self, state: TrainState, x: np.ndarray,
                        batch_size: int = 512) -> np.ndarray:
@@ -663,6 +875,10 @@ class Trainer:
                 # arm the auditor on the first batch (abstract trace of
                 # the active program; no-op unless GEOMX_AUDIT is on)
                 self._audit_capture(state, xb, yb)
+                if self._telemetry and not self._memory_gauge_published:
+                    # once per trainer: the per-chip step-memory gauges
+                    # (geomx_step_memory_bytes) from the compiled program
+                    self.publish_memory_metrics(state, xb, yb)
                 state, metrics = self.train_step(state, xb, yb)
                 it += 1
                 fields = {}

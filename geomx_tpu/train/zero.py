@@ -1,0 +1,391 @@
+"""ZeRO-sharded bucketed weight update (``GEOMX_ZERO=1``).
+
+Every sync algorithm except MultiGPS's big-leaf path ends the step with a
+fully *replicated* weight update: each chip holds the whole optimizer
+state and redundantly applies the identical update W times per party, so
+per-chip optimizer memory and update compute do not shrink as the worker
+axis grows.  "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (PAPERS.md) shows the decomposition
+
+    allreduce(g); update(all)   ==   reduce_scatter(g);
+                                     update(my 1/W shard);
+                                     all_gather(params)
+
+is free in summed wire bytes and wins both memory (optimizer + error-
+feedback state drop ~1/W per chip) and update time (each chip updates
+1/W of the weights).  This module applies that decomposition to the
+*bucketed flat-gradient engine* (compression/bucketing.py): the unit of
+sharding is the fused fp32 bucket, so each worker owns one contiguous,
+lane-aligned ``1/W`` slice of every bucket —
+
+- worker tier (ICI): ``psum_scatter`` on the flat buckets replaces the
+  worker-axis allreduce; each chip keeps the party-mean of its shard;
+- dc tier (DCN): the configured compressor runs per *shard* — each chip
+  compresses, transfers and decompresses only its slice, so the sparse
+  path never materializes a bucket-dense per-party intermediate
+  (Ok-Topk, "Near-Optimal Sparse Allreduce", PAPERS.md) and EF
+  residuals live shard-local;
+- update: the optimizer runs on flat bucket shards (state allocated
+  shard-shaped — the ~1/W per-chip memory claim);
+- one ``all_gather`` per bucket rebuilds the replicated params for the
+  next forward.
+
+Semantics note: element-wise optimizers (SGD/momentum/Adam/...) are
+numerically identical to the replicated update; optimizers coupling
+across a whole tensor (global-norm clipping) would see per-shard
+statistics — the same caveat MultiGPS documents.
+
+In the replica-axes state scheme (train/state.py) a shard leaf is
+``[num_parties, workers_per_party, shard_len]`` sharded ``P(dc,
+worker)``: slot ``(p, w)`` physically holds only worker ``w``'s shard,
+so the content *differs across the worker axis by design* — checkpoint
+and catch-up paths must gather all W shards, not copy ``(0, 0)``
+(``Trainer.save_checkpoint`` / ``load_checkpoint`` handle this,
+including re-sharding onto a different worker count).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from geomx_tpu.compression.bucketing import _LANE_PAD, BucketedCompressor
+
+
+class ZeroPlan:
+    """The sharded-update plan over the worker (ICI) axis.
+
+    Built by ``train.step.build_train_step`` when ``config.zero`` is
+    set and bound into the sync algorithm (``SyncAlgorithm.bind_zero``).
+    Holds only static layout facts — W and the lane alignment — plus
+    the in-``shard_map`` shard ops; the bucket layout itself stays
+    owned by the :class:`BucketedCompressor` so the ZeRO path slices
+    the exact coordinates the replicated path fuses.
+    """
+
+    def __init__(self, workers_per_party: int, lane: int = _LANE_PAD):
+        if workers_per_party < 1:
+            raise ValueError("workers_per_party must be >= 1")
+        self.W = int(workers_per_party)
+        self.lane = int(lane)
+        self.bucketed: "BucketedCompressor | None" = None  # bind_compressor
+
+    @property
+    def pad_to(self) -> int:
+        """Bucket padding that makes every shard lane-aligned: each of
+        the W contiguous shards is a multiple of the TPU lane width (and
+        of the 2-bit packer's 16-codes word)."""
+        return self.lane * self.W
+
+    # ---- wiring ------------------------------------------------------------
+
+    def bind_compressor(self, dc_compressor) -> BucketedCompressor:
+        """Validate the dc-tier compressor stack for the ZeRO path and
+        re-align its bucket padding so buckets split into W lane-aligned
+        shards.  Returns the underlying :class:`BucketedCompressor`.
+        Must run before the first trace resolves a bucket layout."""
+        from geomx_tpu.sync.pipeline import PipelinedCompressor
+        comp = dc_compressor
+        if isinstance(comp, PipelinedCompressor):
+            comp = comp.inner
+        if not isinstance(comp, BucketedCompressor):
+            raise ValueError(
+                "GEOMX_ZERO requires the bucketed dc-tier engine: the "
+                "shard unit is the fused flat bucket.  Re-enable "
+                "bucketing (GEOMX_BUCKET_BYTES > 0) and use a dc "
+                f"compressor it can wrap (got "
+                f"{getattr(dc_compressor, 'name', type(dc_compressor).__name__)!r})")
+        if comp.pad_to % self.pad_to:
+            comp.pad_to = self.pad_to
+            comp._bucketers.clear()  # layouts cached under the old pad
+        self.bucketed = comp
+        return comp
+
+    # ---- inside shard_map --------------------------------------------------
+
+    def shard_len(self, bucket_size: int) -> int:
+        return bucket_size // self.W
+
+    def scatter_bucket(self, bucket: jax.Array,
+                       axis_name: str) -> jax.Array:
+        """Worker-tier mean reduce of one flat bucket: psum_scatter, each
+        slot keeps its contiguous lane-aligned 1/W shard."""
+        if self.W == 1:
+            return bucket
+        s = self.shard_len(bucket.size)
+        return lax.psum_scatter(bucket.reshape(self.W, s), axis_name,
+                                scatter_dimension=0) / self.W
+
+    def slice_shard(self, bucket: jax.Array, widx: jax.Array) -> jax.Array:
+        """This worker's shard of a *replicated* flat bucket (params,
+        stale copies) — a slice, no collective."""
+        if self.W == 1:
+            return bucket
+        s = self.shard_len(bucket.size)
+        return lax.dynamic_slice(bucket, (widx * s,), (s,))
+
+    def gather_bucket(self, shard: jax.Array, axis_name: str) -> jax.Array:
+        """Rebuild the full flat bucket from the W worker shards."""
+        if self.W == 1:
+            return shard
+        return lax.all_gather(shard, axis_name).reshape(-1)
+
+    def tree_shards(self, tree: Any, bk, widx: jax.Array) -> List[jax.Array]:
+        """Flatten a replicated tree onto the bucket layout and slice
+        this worker's shard of every bucket (the param/stale-copy side
+        of the sharded update)."""
+        leaves = jax.tree.leaves(tree)
+        return [self.slice_shard(b, widx) for b in bk.flatten(leaves)]
+
+    def apply_shard_update(self, tx, shard_g: List[jax.Array], params: Any,
+                           opt_state: Any, axis_name: str) -> tuple:
+        """Shard-local optimizer step + param rebuild: slice this
+        worker's param shards, run ``tx`` on (shard gradient, shard
+        param) pairs, all_gather the updated shards back into full
+        buckets and unflatten.  The ONE shard-update path the train
+        step (``_zero_sync_update``) and the pipeline drain share —
+        they must stay in lockstep or a drained resume silently
+        diverges from the in-step update.  Returns
+        ``(params, opt_state)``."""
+        import optax
+        flat_p, treedef = jax.tree.flatten(params)
+        bk = self.bucketed.zero_bucketer(flat_p)
+        widx = lax.axis_index(axis_name)
+        p_shards = [self.slice_shard(b, widx) for b in bk.flatten(flat_p)]
+        updates, opt_state = tx.update(shard_g, opt_state, p_shards)
+        new_shards = optax.apply_updates(p_shards, updates)
+        full = [self.gather_bucket(sh, axis_name) for sh in new_shards]
+        return treedef.unflatten(bk.unflatten(full)), opt_state
+
+    # ---- host-side layout --------------------------------------------------
+
+    def shard_example(self, params: Any,
+                      bucketed: BucketedCompressor) -> List[jax.Array]:
+        """Zero-filled flat bucket shards matching the sharded update's
+        operand structure — what ``tx.init`` sees so optimizer state is
+        allocated shard-shaped (the ~1/W per-chip memory saving)."""
+        leaves = jax.tree.leaves(params)
+        bk = bucketed.zero_bucketer(leaves)
+        return [jnp.zeros((self.shard_len(n),), jnp.float32)
+                for n in bk.bucket_sizes]
+
+    def wire_accounting(self, params: Any) -> dict:
+        """Static per-chip wire bytes of the ZeRO step (floats, resolved
+        at build time; the scatter-family convention analysis/passes.py's
+        ``collective_wire_bytes`` audits): psum_scatter sends
+        ``(W-1)/W`` of each bucket, the params all_gather sends this
+        chip's shard to W-1 peers, and the dc tier carries the inner
+        compressor's payload for one shard."""
+        bucketed = self.bucketed
+        leaves = jax.tree.leaves(params)
+        if not leaves or bucketed is None:
+            return {}
+        bk = bucketed.zero_bucketer(leaves)
+        padded = float(sum(bk.bucket_sizes))
+        frac = (self.W - 1) / self.W
+        return {
+            "zero_scatter_bytes": 4.0 * padded * frac,
+            "zero_gather_bytes": 4.0 * padded * frac,
+            "dc_wire_bytes": float(
+                bucketed.shard_wire_bytes(params, self.W)),
+        }
+
+
+# ---------------------------------------------------------------------------
+# checkpoint canonicalization / re-sharding (Trainer.save/load_checkpoint)
+# ---------------------------------------------------------------------------
+
+def zero_checkpoint_meta(plan: "ZeroPlan | None", topology) -> dict:
+    """The checkpoint meta block that makes sharded state restorable:
+    whether the state is ZeRO-sharded and the worker count it was
+    sharded over (``load_checkpoint`` re-shards when they differ and
+    rejects a GEOMX_ZERO mismatch loudly)."""
+    return {
+        "zero": plan is not None,
+        "num_parties": int(topology.num_parties),
+        "workers_per_party": int(topology.workers_per_party),
+    }
+
+
+def _fit_flat(flat: np.ndarray, n_new: int) -> np.ndarray:
+    """Truncate/zero-extend a full padded flat bucket to a new padded
+    length.  Safe in both directions: positions past the bucket's true
+    fill are lane padding, which is zero by construction in every shard
+    buffer (grads, EF residuals, optimizer moments of a zero-gradient
+    coordinate)."""
+    flat = np.asarray(flat).reshape(-1)
+    if flat.size >= n_new:
+        return np.ascontiguousarray(flat[:n_new])
+    return np.concatenate(
+        [flat, np.zeros((n_new - flat.size,), flat.dtype)])
+
+
+def _fit_shard_leaf(old: np.ndarray, t_shape) -> np.ndarray:
+    """One ZeRO shard leaf ``[P_old, W_old, ...]`` -> ``[P, W, ...]``:
+    concatenate party 0's worker shards back into the full padded flat
+    bucket, re-fit it to the new layout's padded length, split into the
+    new worker count, and broadcast over parties (shard content is
+    identical across parties, distinct across workers)."""
+    old = np.asarray(old)
+    if old.ndim == 2:  # per-slot scalar (e.g. optax count): replicated
+        return np.broadcast_to(old[0, 0], t_shape).copy()
+    full = old[0].reshape(-1)  # W_old shards, contiguous == full bucket
+    n_new = 1
+    for d in t_shape[1:]:
+        n_new *= d
+    return np.broadcast_to(
+        _fit_flat(full, n_new).reshape(t_shape[1:])[None],
+        t_shape).copy()
+
+
+def _fit_replicated_leaf(old: np.ndarray, t_shape) -> np.ndarray:
+    """A replicated leaf ``[P_old, W_old, *r]`` -> ``[P, W, *r]``: every
+    slot holds the same content, so copy ``(0, 0)`` and broadcast."""
+    old = np.asarray(old)
+    v = old[0, 0] if old.ndim >= 2 else old
+    if v.shape != tuple(t_shape[2:]):
+        raise ValueError(
+            f"replicated checkpoint leaf {old.shape} does not fit the "
+            f"target slot {tuple(t_shape)} — the checkpoint was saved "
+            "from a different model/optimizer configuration")
+    return np.broadcast_to(v[None, None], t_shape).copy()
+
+
+def _under_dc_comp(path) -> bool:
+    """Shard-bearing sync state is recognized by ITS DICT KEY: the
+    ZeRO contract (``SyncAlgorithm.supports_zero``) requires shard-
+    shaped dc-tier compressor state to live under the ``"dc_comp"``
+    key of ``sync_state`` — FSA, MixedSync and PipelinedSync all do.
+    host_zero_state / place_zero_state / reshard_zero_state all route
+    on this predicate, so an algorithm that parks shard state under
+    any other key would be silently treated as replicated (worker 0's
+    slice broadcast over the axis).  Keep the key, or extend this
+    predicate together with a bind-time check."""
+    from jax.tree_util import DictKey
+    return any(isinstance(k, DictKey) and k.key == "dc_comp"
+               for k in path)
+
+
+def host_zero_state(state):
+    """One host-side copy of a ZeRO ``TrainState`` for catch-up /
+    inspection: replicated fields collapse to copy ``(0, 0)`` exactly
+    like ``unreplicate_tree``, but shard-bearing fields (the optimizer
+    state and every ``dc_comp`` subtree) keep party 0's FULL worker axis
+    — copying ``(0, 0)`` there would silently drop workers 1..W-1's
+    shards."""
+    from jax.tree_util import tree_map_with_path
+
+    from geomx_tpu.train.state import TrainState
+
+    def rep(x):
+        return np.asarray(jax.device_get(x))[0, 0]
+
+    def shard(x):
+        return np.asarray(jax.device_get(x))[0]
+
+    return TrainState(
+        step=np.asarray(jax.device_get(state.step)),
+        params=jax.tree.map(rep, state.params),
+        opt_state=jax.tree.map(shard, state.opt_state),
+        model_state=jax.tree.map(rep, state.model_state),
+        sync_state=tree_map_with_path(
+            lambda p, x: shard(x) if _under_dc_comp(p) else rep(x),
+            state.sync_state))
+
+
+def place_zero_state(host_state, topology, mesh):
+    """Inverse of :func:`host_zero_state`: re-place a host ZeRO state on
+    the mesh — replicated fields broadcast over both replica axes,
+    shard-bearing fields (leading ``[W, ...]``) broadcast over parties
+    only, so every worker slot gets back exactly its own shard."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.tree_util import tree_map_with_path
+
+    from geomx_tpu.topology import DC_AXIS, WORKER_AXIS
+    from geomx_tpu.train.state import TrainState, replicate_tree
+
+    sharding = NamedSharding(mesh, P(DC_AXIS, WORKER_AXIS))
+
+    def shard(x):
+        x = np.asarray(x)
+        if x.shape[0] != topology.workers_per_party:
+            raise ValueError(
+                f"sharded state leaf carries {x.shape[0]} worker shards "
+                f"but this topology has {topology.workers_per_party} "
+                "workers per party — re-shard the checkpoint "
+                "(Trainer.load_checkpoint) instead of installing it "
+                "directly")
+        return jax.device_put(
+            np.broadcast_to(x[None], (topology.num_parties,) + x.shape),
+            sharding)
+
+    return TrainState(
+        step=jax.device_put(jnp.asarray(host_state.step),
+                            NamedSharding(mesh, P())),
+        params=replicate_tree(host_state.params, topology, mesh),
+        opt_state=jax.tree.map(shard, host_state.opt_state),
+        model_state=replicate_tree(host_state.model_state, topology,
+                                   mesh),
+        sync_state=tree_map_with_path(
+            lambda p, x: shard(x) if _under_dc_comp(p)
+            else replicate_tree(x, topology, mesh),
+            host_state.sync_state))
+
+
+def reshard_zero_state(host_state, template, mesh):
+    """Re-shard a host-side ZeRO ``TrainState`` (numpy leaves with
+    ``[P_old, W_old, ...]`` replica axes, as a checkpoint stores them)
+    onto ``template``'s topology/shardings.
+
+    Field semantics:
+
+    - ``params`` / ``model_state``: replicated — copy ``(0, 0)``;
+    - ``opt_state``: every array leaf is a flat bucket shard (or a
+      per-slot scalar) — gather the old worker shards into the full
+      padded bucket and re-split for the new worker count;
+    - ``sync_state``: leaves under any ``"dc_comp"`` key (EF residuals,
+      the pipelined in-flight buffers) are shard-shaped and re-split
+      like the optimizer's; everything else (worker-tier state, stale
+      copies, the model-state double-buffer) is replicated.
+
+    Shapes come pairwise from ``template`` (same config, new topology),
+    so no bucket-identity bookkeeping is needed; a structure mismatch
+    surfaces as a clear error instead of silent corruption.
+    """
+    from jax.tree_util import tree_map_with_path
+
+    from geomx_tpu.train.state import TrainState
+
+    def place(host, like):
+        return jax.device_put(host, like.sharding)
+
+    def conv_rep(t, o):
+        return place(_fit_replicated_leaf(o, t.shape), t)
+
+    def conv_shard(t, o):
+        return place(_fit_shard_leaf(o, t.shape), t)
+
+    def conv_sync(path, t, o):
+        return (conv_shard(t, o) if _under_dc_comp(path)
+                else conv_rep(t, o))
+
+    try:
+        return TrainState(
+            step=place(np.asarray(host_state.step), template.step),
+            params=jax.tree.map(conv_rep, template.params,
+                                host_state.params),
+            opt_state=jax.tree.map(conv_shard, template.opt_state,
+                                   host_state.opt_state),
+            model_state=jax.tree.map(conv_rep, template.model_state,
+                                     host_state.model_state),
+            sync_state=tree_map_with_path(conv_sync, template.sync_state,
+                                          host_state.sync_state))
+    except ValueError as e:
+        raise ValueError(
+            "cannot re-shard checkpoint onto this trainer: the state "
+            "trees disagree beyond the worker count (different model, "
+            f"optimizer, or sync configuration?) — {e}") from e

@@ -28,33 +28,62 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
-def tree_to_bytes(tree: Any) -> bytes:
+# envelope marker for checkpoints carrying a meta block (ZeRO-sharded
+# state records the worker count it was sharded over); meta-less
+# checkpoints keep the original bare-pickle bytes, so the catch-up
+# protocol's byte-identity with a plain checkpoint is preserved
+_ENVELOPE_KEY = "__geomx_ckpt__"
+
+
+def tree_to_bytes(tree: Any, meta: Optional[dict] = None) -> bytes:
     """Serialize a pytree of host/device arrays to bytes — the one wire
     format checkpoints AND the resilience catch-up protocol share (a
-    re-admitted party installs exactly what a restored process would)."""
-    return pickle.dumps(_to_host(tree), protocol=4)
+    re-admitted party installs exactly what a restored process would).
+    With ``meta``, the blob carries a versioned envelope (restore-time
+    facts like the ZeRO shard layout); without it the bytes are the
+    bare pickle they always were."""
+    host = _to_host(tree)
+    if meta is None:
+        return pickle.dumps(host, protocol=4)
+    return pickle.dumps({_ENVELOPE_KEY: 1, "meta": dict(meta),
+                         "tree": host}, protocol=4)
 
 
-def tree_from_bytes(blob: bytes, target: Optional[Any] = None) -> Any:
+def tree_from_bytes(blob: bytes, target: Optional[Any] = None,
+                    with_meta: bool = False) -> Any:
     """Inverse of :func:`tree_to_bytes`; with ``target``, restores its
-    pytree structure and re-places leaves with the target's shardings."""
-    host_state = pickle.loads(blob)
-    if target is None:
-        return host_state
+    pytree structure and re-places leaves with the target's shardings.
+    ``with_meta``: also return the envelope's meta dict (None for
+    meta-less blobs) as ``(tree, meta)``."""
+    obj = pickle.loads(blob)
+    meta = None
+    if isinstance(obj, dict) and _ENVELOPE_KEY in obj:
+        meta = obj.get("meta")
+        obj = obj["tree"]
+    host_state = obj
+    if target is not None:
+        host_state = place_like(host_state, target)
+    return (host_state, meta) if with_meta else host_state
+
+
+def place_like(host_tree: Any, target: Any) -> Any:
+    """Rebuild ``target``'s pytree structure around ``host_tree``'s
+    leaves, re-placing each onto the matching target leaf's sharding —
+    the one leaf-placement path checkpoint restore and the trainer's
+    same-layout branch share."""
     flat_t, treedef = jax.tree.flatten(target)
-    flat_h = jax.tree.leaves(host_state)
+    flat_h = jax.tree.leaves(host_tree)
     if len(flat_t) != len(flat_h):
-        raise ValueError("checkpoint structure mismatch")
-    placed = []
-    for t, h in zip(flat_t, flat_h):
-        if hasattr(t, "sharding"):
-            placed.append(jax.device_put(h, t.sharding))
-        else:
-            placed.append(h)
+        raise ValueError(
+            "checkpoint structure mismatch: different model/optimizer/"
+            "sync configuration")
+    placed = [jax.device_put(h, t.sharding) if hasattr(t, "sharding") else h
+              for t, h in zip(flat_t, flat_h)]
     return treedef.unflatten(placed)
 
 
-def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
+                    meta: Optional[dict] = None) -> str:
     """Save a pytree (e.g. TrainState). Returns the final path."""
     if step is not None:
         path = os.path.join(path, f"step_{step}")
@@ -62,15 +91,19 @@ def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
     final = path if path.endswith(".ckpt") else path + ".ckpt"
     tmp = final + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(tree_to_bytes(state))
+        f.write(tree_to_bytes(state, meta=meta))
     os.replace(tmp, final)  # a crash mid-write never corrupts a checkpoint
     return final
 
 
-def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
+def load_checkpoint(path: str, target: Optional[Any] = None,
+                    with_meta: bool = False) -> Any:
     """Load a checkpoint; if `target` given, restores its pytree structure
-    and re-places leaves with the target's shardings."""
+    and re-places leaves with the target's shardings.  ``with_meta``
+    also returns the envelope meta (``(tree, meta)``; None when the
+    checkpoint predates the envelope)."""
     if not path.endswith(".ckpt"):
         path = path + ".ckpt"
     with open(path, "rb") as f:
-        return tree_from_bytes(f.read(), target=target)
+        return tree_from_bytes(f.read(), target=target,
+                               with_meta=with_meta)

@@ -170,6 +170,71 @@ def test_axis_index_groups_warns():
 
 
 # --------------------------------------------------------------------------
+# wire accounting: scatter-family per-chip conventions
+# --------------------------------------------------------------------------
+
+def test_collective_wire_bytes_scatter_family_counts_per_chip():
+    """psum counts its operand once (the allreduce convention);
+    psum_scatter sends (N-1)/N of its full operand per chip;
+    all_gather forwards the shard operand to N-1 peers.  The hard-coded
+    operand-once convention used to overcount the scatter's kept shard
+    and undercount the gather at N > 2 — the ZeRO weight path is built
+    from exactly these two."""
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from geomx_tpu.analysis.passes import collective_wire_bytes
+    from geomx_tpu.parallel.collectives import shard_map_compat
+
+    n_axis, n = 4, 1024
+    mesh = Mesh(np.array(jax.devices()[:n_axis]), ("w",))
+
+    def trace(body):
+        fn = shard_map_compat(body, mesh, in_specs=(P("w"),),
+                              out_specs=P("w"))
+        return jax.make_jaxpr(fn)(jnp.zeros((n_axis, n), jnp.float32))
+
+    def allreduce(v):
+        return lax.psum(v, "w")
+
+    def scatter_gather(v):
+        sh = lax.psum_scatter(v[0].reshape(n_axis, n // n_axis), "w",
+                              scatter_dimension=0)
+        return lax.all_gather(sh, "w").reshape(1, n)
+
+    assert collective_wire_bytes(trace(allreduce)) == 4 * n
+    expect = 4 * n * (n_axis - 1) / n_axis \
+        + 4 * (n // n_axis) * (n_axis - 1)
+    assert collective_wire_bytes(trace(scatter_gather)) == int(expect)
+    # the payload convention stays N-independent: every operand once
+    assert collective_wire_bytes(trace(allreduce),
+                                 convention="payload") == 4 * n
+    assert collective_wire_bytes(trace(scatter_gather),
+                                 convention="payload") \
+        == 4 * n + 4 * (n // n_axis)
+
+
+def test_wire_audit_keeps_honest_gather_compressors_clean_at_n4():
+    """bsc/fp16/2bit emulate the dc allreduce with lax.all_gather and
+    declare the documented per-party payload (operand once).  The audit
+    diffs in that payload convention, so the gather's physical (N-1)
+    fan-out must NOT flag them at num_parties > 2 — while the
+    scatter_wire_lie corpus entry (operand + shard vs declared operand)
+    still trips the gate at the same width."""
+    from geomx_tpu.analysis.corpus import CORPUS
+    from geomx_tpu.analysis.passes import audit_wire_accounting
+    from geomx_tpu.compression import get_compressor
+
+    params = {"w": jnp.zeros((4096,), jnp.float32)}
+    for spec in ("fp16", "bsc,0.01", "2bit"):
+        findings = audit_wire_accounting(get_compressor(spec), params,
+                                         num_parties=4)
+        assert findings == [], (spec, [f.message for f in findings])
+    lie = next(e for e in CORPUS if e.name == "scatter_wire_lie").run()
+    assert {f.rule_id for f in lie} == {"GX-DTYPE-002"}
+
+
+# --------------------------------------------------------------------------
 # known-bad corpus: every entry flagged with exactly its rule id
 # --------------------------------------------------------------------------
 

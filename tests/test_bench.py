@@ -176,6 +176,39 @@ def test_resume_clears_error_only_when_all_units_good():
     assert not bench._resume_clears_error(results, True, None)
 
 
+def test_compare_zero_watchdog_publishes_phase_forensics():
+    """The BENCH_r05 follow-through for the micro-modes: a wedged
+    --compare-zero run must publish the same forensic bundle the main
+    bench's watchdog does — the hung phase by name, the per-phase
+    timestamp trail, and the child's faulthandler stacks — instead of
+    burning the budget silently."""
+    env = dict(os.environ)
+    env.update({
+        "GEOMX_BENCH_TIMEOUT": "4",
+        # wedge the child right after its first phase mark, before the
+        # jax import, so the test bounds at ~10s
+        "GEOMX_BENCH_FAULT_HANG_INIT": "120",
+    })
+    env.pop("GEOMX_BENCH_COMPARE_CHILD", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--compare-zero", "--model=mlp"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=90)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, out.stderr[-2000:]
+    rec = json.loads(lines[-1])
+    assert rec["mode"] == "compare_zero"
+    assert rec.get("ok") is not True
+    assert "watchdog" in rec, rec.get("error")
+    wd = rec["watchdog"]
+    assert wd["phase"] == "child_start"
+    assert "child_start" in wd["init_phases"]
+    assert "backend_up" not in wd["init_phases"]
+    stacks = "\n".join(wd["stacks"])
+    assert "time.sleep" in stacks or "File" in stacks, stacks[:500]
+    assert "watchdog" in rec["error"]
+
+
 def test_watchdog_publishes_stacks_and_init_phases(tmp_path):
     """Watchdog diagnosability (BENCH_r05 recorded only "backend init
     exceeded 480s" twice, with zero clue where it hung): when the init
