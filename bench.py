@@ -82,6 +82,19 @@ Micro-modes:
       parser, and a merged 2-party WAN round trace with round_id-linked
       spans.  Artifacts (merged trace + JSONL event log) land in
       --out-dir.  CPU, no TPU needed.
+  bench.py --attribute [--model=resnet20] [--iters=6] [--dcn-ms=100]
+           [--batch=64] [--out-dir=/tmp/...]
+      One JSON line for the step-time observatory (docs/telemetry.md):
+      per-step phase breakdown (compute / hidden comms / exposed comms
+      / host stall — the four fractions sum to ~1.0) for vanilla, bsc
+      and pipelined configs on the 2x4 mesh, the modeled breakdown
+      under an injected DCN delay (exposed comms must drop under
+      GEOMX_PIPELINE_DEPTH=1), MFU + roofline bound verdict from
+      cost_analysis, a LinkObservatory replay reproducing an injected
+      per-link bandwidth asymmetry, and a deterministic flight-recorder
+      NaN auto-dump naming the poisoned party.  Artifacts (per-config
+      phase JSON, flight bundle, merged WAN trace) land in --out-dir.
+      CPU, no TPU needed.
 
 Env knobs:
   GEOMX_BENCH_PLATFORM=cpu   debug on the host CPU (tiny shapes)
@@ -2298,6 +2311,333 @@ def compare_telemetry_main(argv):
 
 
 # --------------------------------------------------------------------------
+# --attribute: the step-time observatory's acceptance mode
+# --------------------------------------------------------------------------
+
+
+def _modeled_attribution_trace(compute_us, dcn_us, comm_on_weight_path):
+    """Synthesize a Chrome-trace timeline from MEASURED per-step compute
+    durations plus the DCE-verified dependency structure, with the DCN
+    delay injected per that structure — compare-pipeline's modeling rule
+    in trace form:
+
+    - collective ON the weight path (synchronous): the step blocks on
+      the wire, so the comm span follows compute serially inside the
+      step window (it all shows up as exposed_comms);
+    - collective OFF the weight path (pipelined): the collective
+      launched as step t's gradients land completes under step t+1's
+      compute, so the comm span overlaps the next window (hidden_comms,
+      with only the part outrunning compute exposed).
+
+    attribute_trace over this timeline is the modeled phase breakdown
+    under the delay.  On a serial host backend the modeling is the only
+    honest way to show the overlap: a slept delay would block both
+    modes equally (see _compare_pipeline)."""
+    events = []
+    t = 0.0
+    inflight_end = 0.0
+    for i, c in enumerate(compute_us):
+        if comm_on_weight_path:
+            step_dur = c + dcn_us
+            comm_start = t + c
+        else:
+            step_dur = max(c, inflight_end - t)
+            comm_start = t + c           # launch when the grads are ready
+            inflight_end = comm_start + dcn_us
+        events.append({"name": "train/step", "cat": "step", "ph": "X",
+                       "ts": t, "dur": step_dur, "pid": 1, "tid": 1,
+                       "args": {"step": i}})
+        events.append({"name": "train/compute", "cat": "compute",
+                       "ph": "X", "ts": t, "dur": c, "pid": 1, "tid": 1})
+        events.append({"name": ("dc_allreduce/injected"
+                                if comm_on_weight_path
+                                else "dc_pipeline/launch"),
+                       "cat": "comm", "ph": "X", "ts": comm_start,
+                       "dur": dcn_us, "pid": 1, "tid": 2})
+        t += step_dur
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"modeled": True, "dcn_us": dcn_us,
+                         "comm_on_weight_path": bool(comm_on_weight_path)}}
+
+
+def _attribute_links_record(out_dir: str) -> dict:
+    """The LinkObservatory half of --attribute: fold a REAL 2-party
+    host-plane round trace (the compare-telemetry harness) into one
+    observatory, then replay two synthetic per-party round traces with
+    an INJECTED 8x bandwidth asymmetry and verify the snapshot
+    reproduces it."""
+    from geomx_tpu.telemetry.links import LinkObservatory
+
+    obs_real = LinkObservatory()
+    real = _host_plane_trace(out_dir)
+    with open(real["merged_trace"]) as f:
+        merged = json.load(f)
+    folded_real = obs_real.ingest_trace(merged)
+    real_links = sorted(obs_real.snapshot())
+
+    # injected asymmetry: party0's uplink moves the same payload 8x
+    # faster than party1's.  Timestamps/anchors are pinned constants —
+    # replaying the same rounds must produce the same snapshot.
+    anchor_us = 1_700_000_000 * 1e6
+    payload = 1 << 20                      # 1 MiB per round
+    fast_s, ratio_injected = 0.050, 8.0
+    slow_s = fast_s * ratio_injected
+    obs = LinkObservatory(alpha=0.3, stale_after_s=30.0)
+    for rank, secs in ((0, fast_s), (1, slow_s)):
+        events = []
+        ts = 0.0
+        for r in range(6):
+            events.append({"name": "RelayToGlobal:w", "cat": "comm",
+                           "ph": "X", "ts": ts, "dur": secs * 1e6,
+                           "pid": 100 + rank, "tid": 1,
+                           "args": {"key": "w", "round_id": r,
+                                    "payload_bytes": payload}})
+            ts += 2 * secs * 1e6
+        obs.ingest_trace({"traceEvents": events,
+                          "metadata": {"anchor_unix_us": anchor_us,
+                                       "rank": rank}})
+    snap = obs.snapshot(now=anchor_us / 1e6 + 1.0)
+    bw0 = snap["rank0->global"]["throughput_bps"]
+    bw1 = snap["rank1->global"]["throughput_bps"]
+    ratio_measured = bw0 / bw1 if bw1 else None
+    return {
+        "real_rounds_folded": folded_real,
+        "real_links": real_links,
+        "wan_rounds_traced": real["wan_rounds_traced"],
+        "trace_rounds_linked": real["trace_rounds_linked"],
+        "injected_bandwidth_ratio": ratio_injected,
+        "measured_bandwidth_ratio": (round(ratio_measured, 4)
+                                     if ratio_measured else None),
+        "asymmetry_reproduced": (
+            ratio_measured is not None
+            and abs(ratio_measured - ratio_injected) / ratio_injected
+            < 0.01),
+        "snapshot": {k: {f: snap[k][f] for f in
+                         ("throughput_bps", "rtt_s", "loss_rate",
+                          "samples", "confidence", "stale")}
+                     for k in sorted(snap)},
+    }
+
+
+def _attribute_flight_record(out_dir: str, healthy_probes: list) -> dict:
+    """The flight-recorder half of --attribute: prime a recorder with
+    REAL probe records from the measured run, then replay a seeded
+    healthy tail and inject a NaN into party 1's per-party vector at a
+    known step.  The auto-dump must fire at exactly that step and the
+    bundle must name the poisoned party."""
+    import numpy as np
+
+    from geomx_tpu.telemetry.flight import FlightRecorder
+
+    flight_dir = os.path.join(out_dir, "flight")
+    rec = FlightRecorder(capacity=64, dump_dir=flight_dir)
+    step = 0
+    for probes in healthy_probes:
+        fired = rec.record(step, probes)
+        assert not fired, f"healthy probes fired {fired}"
+        step += 1
+    rng = np.random.RandomState(1234)
+    base = healthy_probes[-1] if healthy_probes else {
+        "grad_norm_global": 1.0, "party_grad_nonfinite": [0.0, 0.0]}
+    for _ in range(8):                       # seeded healthy tail
+        p = dict(base)
+        p["grad_norm_global"] = float(
+            abs(base.get("grad_norm_global", 1.0))
+            * (1.0 + 0.01 * rng.randn()))
+        p["party_grad_nonfinite"] = [0.0, 0.0]
+        fired = rec.record(step, p)
+        step += 1
+    poison_step = step
+    poisoned = dict(base)
+    poisoned["grad_norm_global"] = float("nan")
+    poisoned["party_grad_nonfinite"] = [0.0, 1.0]
+    fired = rec.record(poison_step, poisoned)
+    bundle = None
+    if rec.dumps:
+        with open(rec.dumps[-1]) as f:
+            bundle = json.load(f)
+    return {
+        "fired_rules": sorted({f["rule"] for f in fired}),
+        "fired_at_step": poison_step if fired else None,
+        "bundle_path": rec.dumps[-1] if rec.dumps else None,
+        "bundle_poisoned_parties": (bundle or {}).get("poisoned_parties"),
+        "bundle_ring_len": len((bundle or {}).get("ring", [])),
+        "deterministic_trigger": bool(
+            fired and bundle
+            and bundle["step"] == poison_step
+            and bundle["poisoned_parties"] == [1]),
+    }
+
+
+def _attribute(model_name: str = "resnet20", batch: int = 64,
+               iters: int = 6, dcn_ms: float = 100.0,
+               out_dir: str = None):
+    """The step-time observatory's acceptance run on a 2x4 CPU mesh
+    (8 virtual devices), for three configs — vanilla, bsc, pipelined:
+
+    1. run real steps with the host profiler bracketing each dispatch
+       (train/step + train/compute, the same spans Trainer.fit emits)
+       and attribute the REAL trace: the four phase fractions must sum
+       to ~1.0 by construction;
+    2. model the phase breakdown under an injected DCN delay from the
+       measured compute durations + the DCE-verified dependency
+       structure (_modeled_attribution_trace): the exposed-comms
+       fraction must DROP when GEOMX_PIPELINE_DEPTH=1 takes the
+       collective off the weight path;
+    3. grade each config against the roofline (telemetry/roofline.py):
+       MFU + compute/memory/wire bound verdict from
+       ``compiled.cost_analysis()`` and the sync algorithm's wire
+       accounting;
+    4. fold WAN round traces into the LinkObservatory and verify an
+       injected per-link bandwidth asymmetry is reproduced from replay;
+    5. prime a flight recorder with the run's real probe records and
+       verify the seeded NaN injection auto-dumps a bundle naming the
+       poisoned party.
+
+    One JSON line out; artifacts (per-config phase JSON, flight
+    bundles, merged WAN trace) land in ``out_dir`` for CI to upload.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.telemetry.attribution import (attribute_trace,
+                                                 publish_attribution)
+    from geomx_tpu.telemetry.roofline import trainer_roofline
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+    from geomx_tpu.utils.profiler import Profiler
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise RuntimeError(
+            "--attribute needs the 8-virtual-device mesh (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    out_dir = out_dir or tempfile.mkdtemp(prefix="geomx_attribute_")
+    os.makedirs(out_dir, exist_ok=True)
+    topo = HiPSTopology(num_parties=2, workers_per_party=4)
+    local_b = max(1, batch // 8)
+    rng = np.random.RandomState(0)
+    x = (rng.rand(2, 4, local_b, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(2, 4, local_b)).astype(np.int32)
+    dcn_us = dcn_ms * 1e3
+
+    configs = {
+        "vanilla": dict(compression="none", pipeline_depth=0),
+        "bsc": dict(compression="bsc,0.01", pipeline_depth=0),
+        "pipelined": dict(compression="none", pipeline_depth=1),
+    }
+    per_config = {}
+    healthy_probes = []
+    for name, kw in configs.items():
+        cfg = GeoConfig(num_parties=2, workers_per_party=4,
+                        telemetry=True, **kw)
+        trainer = Trainer(get_model(model_name, num_classes=10), topo,
+                          optax.sgd(0.1, momentum=0.9),
+                          sync=get_sync_algorithm(cfg), config=cfg,
+                          donate=False)
+        sharding = topo.batch_sharding(trainer.mesh)
+        xb = jax.device_put(x, sharding)
+        yb = jax.device_put(y, sharding)
+        state = trainer.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+        structure = _dc_weight_path_analysis(trainer.train_step, state,
+                                             xb, yb)
+        state, m = trainer.train_step(state, xb, yb)   # compile + warm
+        jax.block_until_ready(m["loss"])
+
+        prof = Profiler(profile_all=True)
+        prof.set_state(True)
+        for i in range(iters):
+            with prof.scope("train/step", "step", args={"step": i}):
+                with prof.scope("train/compute", "compute"):
+                    state, m = trainer.train_step(state, xb, yb)
+                    jax.block_until_ready(m["loss"])
+        prof.set_state(False)
+        telem = jax.device_get(m.get("telemetry", {}))
+        if telem:
+            healthy_probes.append({
+                k: (float(v) if np.ndim(v) == 0
+                    else [float(u) for u in np.asarray(v)])
+                for k, v in telem.items()})
+
+        att_real = attribute_trace(prof.to_doc())
+        compute_us = [s["compute"] + s["hidden_comms"]
+                      for s in att_real["steps"]]
+        on_path = structure.get("dc_collectives_on_weight_path")
+        att_model = attribute_trace(_modeled_attribution_trace(
+            compute_us, dcn_us, comm_on_weight_path=bool(on_path)))
+        step_s = (sum(compute_us) / len(compute_us)) / 1e6
+        roof = trainer_roofline(trainer, state, xb, yb,
+                                step_time_s=step_s,
+                                wire_seconds=dcn_ms / 1e3)
+        publish_attribution(att_model["summary"])
+        frac_sum = sum(att_real["summary"].values())
+        per_config[name] = {
+            **structure,
+            "steps": att_real["num_steps"],
+            "phase_fractions": {k: round(v, 4)
+                                for k, v in att_real["summary"].items()},
+            "phase_fractions_sum": round(frac_sum, 6),
+            "fractions_sum_ok": abs(frac_sum - 1.0) < 1e-6,
+            "modeled_under_delay": {
+                k: round(v, 4) for k, v in att_model["summary"].items()},
+            "step_time_ms": round(step_s * 1e3, 3),
+            "mfu": (round(roof["mfu"], 6)
+                    if roof.get("mfu") is not None else None),
+            "arithmetic_intensity": (
+                round(roof["arithmetic_intensity"], 3)
+                if roof.get("arithmetic_intensity") is not None else None),
+            "bound": roof["bound"],
+            "bound_times_s": {k: round(v, 6) for k, v in
+                              (roof.get("bound_times_s") or {}).items()},
+            "cost_analysis_available": roof["cost_analysis_available"],
+            "peak_calibrated": roof["peak_calibrated"],
+            "wire_bytes_per_step": roof.get("wire_bytes_per_step"),
+        }
+        with open(os.path.join(out_dir, f"attribution_{name}.json"),
+                  "w") as f:
+            json.dump({"real": att_real, "modeled": att_model,
+                       "roofline": roof}, f, indent=2, default=str)
+
+    sync_exposed = per_config["vanilla"]["modeled_under_delay"][
+        "exposed_comms"]
+    pipe_exposed = per_config["pipelined"]["modeled_under_delay"][
+        "exposed_comms"]
+    links = _attribute_links_record(out_dir)
+    flight = _attribute_flight_record(out_dir, healthy_probes)
+    return {
+        "mode": "attribute", "model": model_name, "batch": batch,
+        "iters": iters, "dcn_delay_ms": dcn_ms,
+        "configs": per_config,
+        "exposed_comms_sync": sync_exposed,
+        "exposed_comms_pipelined": pipe_exposed,
+        "exposed_drops_under_pipelining": pipe_exposed < sync_exposed,
+        "links": links,
+        "flight": flight,
+        "artifacts": {"out_dir": out_dir},
+    }
+
+
+def attribute_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--iters="):
+            kwargs["iters"] = int(a.split("=", 1)[1])
+        elif a.startswith("--dcn-ms="):
+            kwargs["dcn_ms"] = float(a.split("=", 1)[1])
+        elif a.startswith("--out-dir="):
+            kwargs["out_dir"] = a.split("=", 1)[1]
+    _emit(_attribute(**kwargs))
+
+
+# --------------------------------------------------------------------------
 # parent: watchdog + single-line aggregation
 # --------------------------------------------------------------------------
 
@@ -2698,6 +3038,17 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=4").strip()
         audit_main(sys.argv[1:])
+    elif "--attribute" in sys.argv:
+        # step-time observatory acceptance: in-process on the CPU
+        # backend with the 2x4 virtual mesh (8 devices, env before the
+        # first jax import) — same mesh the MULTICHIP matrix uses
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        attribute_main(sys.argv[1:])
     elif "--compare-telemetry" in sys.argv:
         # telemetry acceptance micro-mode: in-process on the CPU backend
         # with a 2-device virtual mesh (env before the first jax import)

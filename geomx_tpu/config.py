@@ -138,6 +138,17 @@ class GeoConfig:
     # one rotation generation)
     telemetry_events: str = ""
 
+    # ---- flight recorder (telemetry/flight.py; docs/telemetry.md):
+    # bounded in-memory ring of the last N per-step records (probe
+    # values, phase breakdown, membership epoch) with deterministic
+    # anomaly rules — nonfinite probe, grad-norm spike, density drift,
+    # exposed-comms jump — that auto-dump a forensics bundle when they
+    # fire.  Needs the telemetry probes (flight without telemetry has
+    # nothing to record; the trainer warns).
+    flight: bool = False
+    flight_steps: int = 0         # ring capacity; 0 = default 256
+    flight_dir: str = ""          # bundle dir; "" = ./geomx_flight
+
     # ---- static analysis (analysis/: the Graft Auditor; docs/analysis.md)
     # Off by default.  When on, the Trainer checks the collective
     # signature of every membership-recompiled step program against the
@@ -205,6 +216,10 @@ class GeoConfig:
                 ["GEOMX_HEARTBEAT_TIMEOUT", "PS_HEARTBEAT_TIMEOUT"], 15.0, float),
             telemetry=_env_bool(["GEOMX_TELEMETRY"], False),
             telemetry_events=_env(["GEOMX_TELEMETRY_EVENTS"], "", str),
+            flight=_env_bool(["GEOMX_FLIGHT"], False),
+            flight_steps=_env(["GEOMX_FLIGHT_STEPS"], 0,
+                              lambda s: int(float(s))),
+            flight_dir=_env(["GEOMX_FLIGHT_DIR"], "", str),
             audit=_env_bool(["GEOMX_AUDIT"], False),
             audit_severity=_env(["GEOMX_AUDIT_SEVERITY"], "error", str),
             resilience_residuals=_env(

@@ -73,6 +73,7 @@ class GeoScheduler:
         self._epoch = 0
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
 
+        self._started_monotonic = time.monotonic()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if bind_host is None:
@@ -110,6 +111,22 @@ class GeoScheduler:
         self._m_req_s = reg.histogram(
             "geomx_scheduler_request_seconds",
             "Scheduler request handling latency")
+        # build-info gauge (the Prometheus idiom for version labels:
+        # constant 1, identity in the labels) — what version/jax pairing
+        # a scrape is actually talking to.  importlib.metadata avoids
+        # importing jax into the scheduler process just for a string.
+        from geomx_tpu import __version__
+        try:
+            from importlib.metadata import version as _pkg_version
+            jax_version = _pkg_version("jax")
+        except Exception:
+            jax_version = "unavailable"
+        self.build_info = {"version": __version__,
+                           "jax_version": jax_version}
+        reg.gauge("geomx_build_info",
+                  "Constant 1; the build identity lives in the labels",
+                  ("version", "jax_version")).labels(
+            version=__version__, jax_version=jax_version).set(1.0)
         # Prometheus scrape endpoint: explicit metrics_port wins, else
         # GEOMX_METRICS_PORT (0 = ephemeral), else no HTTP surface
         self._metrics_srv = None
@@ -126,19 +143,55 @@ class GeoScheduler:
         if metrics_port is not None:
             self._start_metrics_http(bind_host, int(metrics_port))
 
+    def health_snapshot(self) -> dict:
+        """The ``GET /healthz`` body: roster epoch, per-role roster
+        sizes, live/dead party counts from the heartbeat monitor,
+        uptime, and the build identity — the standard liveness shape
+        the serving-plane work (ROADMAP item 4) inherits."""
+        with self._lock:
+            epoch = self._epoch
+            roster = {role: len(nodes)
+                      for role, nodes in sorted(self._roster.items())}
+        alive = self.heartbeats.alive_nodes()
+        dead = self.heartbeats.dead_nodes()
+        return {
+            "status": "ok",
+            "roster_epoch": epoch,
+            "roster": roster,
+            "live_parties": len(alive),
+            "dead_parties": len(dead),
+            "dead_node_ids": dead,
+            "uptime_s": round(time.monotonic() - self._started_monotonic,
+                              3),
+            "build": dict(self.build_info),
+        }
+
     def _start_metrics_http(self, bind_host: str, port: int) -> None:
         """Serve ``GET /metrics`` (Prometheus text exposition of the
-        process-global registry) from a daemon HTTP thread."""
+        process-global registry) and ``GET /healthz`` (JSON liveness:
+        roster epoch, live parties, uptime) from a daemon HTTP thread."""
+        import json as _json
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        sched = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(h):
                 from geomx_tpu.telemetry import render_prometheus
                 from geomx_tpu.telemetry.export import CONTENT_TYPE
-                if h.path.partition("?")[0].rstrip("/") in ("", "/metrics"):
+                route = h.path.partition("?")[0].rstrip("/")
+                if route in ("", "/metrics"):
                     body = render_prometheus().encode("utf-8")
                     h.send_response(200)
                     h.send_header("Content-Type", CONTENT_TYPE)
+                    h.send_header("Content-Length", str(len(body)))
+                    h.end_headers()
+                    h.wfile.write(body)
+                elif route == "/healthz":
+                    body = _json.dumps(
+                        sched.health_snapshot()).encode("utf-8")
+                    h.send_response(200)
+                    h.send_header("Content-Type", "application/json")
                     h.send_header("Content-Length", str(len(body)))
                     h.end_headers()
                     h.wfile.write(body)

@@ -863,9 +863,13 @@ class GeoPSServer:
                          round_: Optional[int] = None) -> np.ndarray:
         """Push the party aggregate up, pull fresh globals back
         (DataPushToGlobalServers* + DataPullFromGlobalServers*).
-        ``round_`` tags the span for cross-party round correlation."""
-        with self.profiler.scope(f"RelayToGlobal:{key}", "comm",
-                                 args={"key": key, "round_id": round_}):
+        ``round_`` tags the span for cross-party round correlation;
+        ``payload_bytes`` makes the span a throughput observation the
+        LinkObservatory (telemetry/links.py) can fold on replay."""
+        with self.profiler.scope(
+                f"RelayToGlobal:{key}", "comm",
+                args={"key": key, "round_id": round_,
+                      "payload_bytes": int(np.asarray(grad).nbytes)}):
             return self._relay_to_global_impl(key, grad)
 
     def _relay_to_global_impl(self, key: str, grad: np.ndarray) -> np.ndarray:
@@ -963,8 +967,11 @@ class GeoPSServer:
             # placement like the dense path, so split keys route correctly
             place = self._placement(key, self._store[key].value.shape)
             self._gplace[key] = place
-        with self.profiler.scope(f"RelayRowSparse:{key}", "comm",
-                                 args={"key": key, "round_id": round_}):
+        with self.profiler.scope(
+                f"RelayRowSparse:{key}", "comm",
+                args={"key": key, "round_id": round_,
+                      "payload_bytes": int(rows_arr.nbytes
+                                           + np.asarray(vals).nbytes)}):
             if place["owner"] >= 0:
                 c = self._gclients[place["owner"]]
                 c.push_row_sparse(key, rows_arr, vals, timeout=120.0)
@@ -1506,6 +1513,12 @@ class GeoPSServer:
                 self._m_relay_s.observe(time.perf_counter() - t_relay)
             except Exception as e:
                 self._m_relay_fail.inc()
+                # loss observation for the LinkObservatory's trace replay
+                # (telemetry/links.py): a failed WAN round is one lost
+                # transfer on this party's uplink
+                self.profiler.instant(
+                    f"RelayFailure:{key}", "comm",
+                    args={"key": key, "round_id": round_})
                 # the round can never complete: fail current waiters fast
                 # with the reason, latch the error so pulls that arrive
                 # AFTER the failure (the common case — the network round

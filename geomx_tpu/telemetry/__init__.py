@@ -1,5 +1,7 @@
 """Unified telemetry plane (docs/telemetry.md).
 
+Sensors:
+
 - :mod:`registry` — process-global Counter/Gauge/Histogram registry
   every subsystem writes into;
 - :mod:`probes` — in-graph step-health probes (grad norm, NaN/Inf,
@@ -9,14 +11,37 @@
   spans + :func:`merge_traces`);
 - :mod:`export` — Prometheus text exposition and the bounded JSONL
   event log.
+
+Interpretation (the step-time observatory, built on the sensors):
+
+- :mod:`attribution` — Chrome traces -> per-step compute / hidden-comms
+  / exposed-comms / host-stall phase breakdown;
+- :mod:`roofline` — MFU, arithmetic intensity and a compute/memory/
+  wire bound verdict from ``compiled.cost_analysis()`` + wire
+  accounting;
+- :mod:`links` — per-(party, peer) EWMA throughput/RTT/loss estimates
+  from replayed WAN round spans (:class:`LinkObservatory`);
+- :mod:`flight` — bounded per-step flight recorder with deterministic
+  anomaly rules and forensics bundles (``GEOMX_FLIGHT``).
 """
 
+from geomx_tpu.telemetry.attribution import (attribute_merged,
+                                             attribute_trace,
+                                             classify_span,
+                                             publish_attribution)
 from geomx_tpu.telemetry.export import (EventLog, get_event_log, log_event,
                                         parse_prometheus_text,
                                         render_prometheus)
+from geomx_tpu.telemetry.flight import (FlightRecorder, flight_enabled,
+                                        flight_recorder_from_config)
+from geomx_tpu.telemetry.links import (LinkObservatory,
+                                       get_link_observatory,
+                                       reset_link_observatory)
 from geomx_tpu.telemetry.probes import telemetry_enabled
 from geomx_tpu.telemetry.registry import (MetricRegistry, get_registry,
                                           reset_registry)
+from geomx_tpu.telemetry.roofline import (publish_roofline, roofline_record,
+                                          trainer_roofline)
 from geomx_tpu.telemetry.tracing import merge_traces, rounds_in_trace
 
 __all__ = [
@@ -25,4 +50,9 @@ __all__ = [
     "EventLog", "get_event_log", "log_event",
     "render_prometheus", "parse_prometheus_text",
     "merge_traces", "rounds_in_trace",
+    "attribute_trace", "attribute_merged", "classify_span",
+    "publish_attribution",
+    "roofline_record", "trainer_roofline", "publish_roofline",
+    "LinkObservatory", "get_link_observatory", "reset_link_observatory",
+    "FlightRecorder", "flight_enabled", "flight_recorder_from_config",
 ]

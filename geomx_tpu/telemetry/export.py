@@ -241,6 +241,7 @@ class EventLog:
         self.max_event_bytes = int(max_event_bytes)
         self._lock = threading.Lock()
         self.write_errors = 0
+        self.rotations = 0
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -271,6 +272,19 @@ class EventLog:
                     self.write_errors += 1
                     return
                 self._size = 0
+                self.rotations += 1
+                # a rotation discards a generation of history — publish
+                # it so operators learn about the loss from a scrape,
+                # not from a forensics dead end (best-effort like the
+                # write itself: a foreign schema conflict on the name
+                # must not take down the subsystem being observed)
+                try:
+                    get_registry().counter(
+                        "geomx_eventlog_rotations_total",
+                        "Event-log rotations (each discards the "
+                        "previous rotated generation)").inc()
+                except ValueError:
+                    pass
                 marker = json.dumps({"ts": rec["ts"],
                                      "kind": "rotated"}) + "\n"
                 data = marker.encode("utf-8") + data

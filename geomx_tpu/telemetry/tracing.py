@@ -123,6 +123,18 @@ def merge_traces(traces: Sequence[Any],
     }
 
 
+def process_names(doc: dict) -> Dict[int, str]:
+    """pid -> label from a trace's ``process_name`` metadata rows (what
+    :func:`merge_traces` writes per party) — the one place the metadata
+    shape is known to the observatory consumers (attribution, links)."""
+    names: Dict[int, str] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            names[ev.get("pid", 0)] = (ev.get("args") or {}).get(
+                "name", str(ev.get("pid")))
+    return names
+
+
 def rounds_in_trace(doc: dict) -> Dict[Tuple[str, int], List[dict]]:
     """Group a (merged or single) trace's correlated events by
     (key, round_id) — the assertion surface for tests and bench."""

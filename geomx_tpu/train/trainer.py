@@ -128,6 +128,22 @@ class Trainer:
         self._audit_args = None     # (state, x, y) ShapeDtypeStructs
         self._audit_sigs: dict = {}  # membership key -> signature
         self._telem_last_it = 0
+        # flight recorder (telemetry/flight.py, GEOMX_FLIGHT): a bounded
+        # ring of per-step records with deterministic anomaly rules and
+        # forensics auto-dumps, fed at the same publish boundaries as
+        # the registry.  Rides the probes — without telemetry there is
+        # nothing to record, so that misconfig warns instead of
+        # silently recording empty rings.
+        from geomx_tpu.telemetry.flight import flight_recorder_from_config
+        self._flight = flight_recorder_from_config(self.config)
+        self._attr_window_us = None  # trace mark of the last flight window
+        if self._flight is not None and not self._telemetry:
+            import warnings
+            warnings.warn(
+                "GEOMX_FLIGHT is on but telemetry is off: the flight "
+                "recorder rides the in-graph step probes — enable "
+                "GEOMX_TELEMETRY/GeoConfig(telemetry=True) or the ring "
+                "records nothing", RuntimeWarning, stacklevel=2)
         self._event_log = None
         events_path = getattr(self.config, "telemetry_events", "")
         if events_path:
@@ -610,6 +626,37 @@ class Trainer:
                                  **flat)
         else:
             log_event("step_probes", iteration=iteration, **flat)
+        if self._flight is not None:
+            fired = self._flight.record(
+                iteration, flat,
+                membership_version=self._membership_version,
+                phases=self._attribution_phases())
+            if fired:
+                ev = dict(iteration=iteration, fired=fired,
+                          bundle=(self._flight.dumps[-1]
+                                  if self._flight.dumps else None))
+                if self._event_log is not None:
+                    self._event_log.emit("flight_anomaly", **ev)
+                else:
+                    log_event("flight_anomaly", **ev)
+
+    def _attribution_phases(self) -> Optional[dict]:
+        """Phase-fraction summary of the ``train/step`` spans the host
+        profiler recorded since the previous publish boundary (None when
+        the profiler is off or no step span landed in the window) — the
+        ``phases`` feed the flight recorder's exposed_comms_jump rule
+        watches.  Advances the window mark so consecutive publishes see
+        disjoint span windows."""
+        from geomx_tpu.utils.profiler import get_profiler
+        prof = get_profiler()
+        if not prof.running:
+            return None
+        from geomx_tpu.telemetry.attribution import attribute_trace
+        att = attribute_trace(prof.to_doc(), since_us=self._attr_window_us)
+        self._attr_window_us = prof.now_us()
+        if not att["num_steps"]:
+            return None
+        return att["summary"]
 
     def step_memory_stats(self, state: TrainState, xb, yb):
         """Compiled-step memory accounting from XLA's
@@ -825,6 +872,15 @@ class Trainer:
         # base must too — a stale high-water mark from a previous fit
         # would silently swallow this fit's step/byte counter increments
         self._telem_last_it = 0
+        # step-time attribution windows restart per fit too: mark the
+        # trace clock now so a long-lived process whose global profiler
+        # accumulated spans across earlier fits (or other profiled work)
+        # attributes only THIS fit's steps — both for the fit-end
+        # geomx_phase_fraction summary and the per-publish flight windows
+        from geomx_tpu.utils.profiler import get_profiler
+        prof = get_profiler()
+        fit_since_us = prof.now_us() if prof.running else None
+        self._attr_window_us = fit_since_us
         if scan_epochs:
             if not getattr(loader, "device_cache", False):
                 raise ValueError("scan_epochs requires device_cache=True "
@@ -870,6 +926,14 @@ class Trainer:
         on_cpu = jax.devices()[0].platform == "cpu"
         sync_every = 1 if on_cpu else max(1, log_every or 32)
         it = 0
+        # step-time attribution (telemetry/attribution.py): when the
+        # host profiler is running, every step dispatch is bracketed as
+        # a train/step + train/compute span pair so attribute_trace can
+        # partition the fit's wall clock into compute / comms / stall.
+        # scope() no-ops when the profiler is off.  Caveat: with async
+        # dispatch the compute span measures dispatch+host time only —
+        # the CPU backend (and any blocking sync_every boundary) is the
+        # regime where it is the real step.
         for epoch in range(epochs):
             for xb, yb in loader.epoch(epoch):
                 # arm the auditor on the first batch (abstract trace of
@@ -879,7 +943,10 @@ class Trainer:
                     # once per trainer: the per-chip step-memory gauges
                     # (geomx_step_memory_bytes) from the compiled program
                     self.publish_memory_metrics(state, xb, yb)
-                state, metrics = self.train_step(state, xb, yb)
+                with prof.scope("train/step", "step",
+                                args={"step": it}):
+                    with prof.scope("train/compute", "compute"):
+                        state, metrics = self.train_step(state, xb, yb)
                 it += 1
                 fields = {}
                 if log_every and it % log_every == 0:
@@ -906,4 +973,13 @@ class Trainer:
                 rec = measure.add(epoch=epoch, iteration=it,
                                   test_acc=self.evaluate(state, *eval_data))
                 log_fn(json.dumps(rec))
+        if self._telemetry and prof.running:
+            # publish the fit's phase-fraction summary from the step
+            # spans recorded above (geomx_phase_fraction gauges) — the
+            # scrapeable form of bench --attribute's breakdown
+            from geomx_tpu.telemetry.attribution import (
+                attribute_trace, publish_attribution)
+            att = attribute_trace(prof.to_doc(), since_us=fit_since_us)
+            if att["num_steps"]:
+                publish_attribution(att["summary"])
         return state, measure.records

@@ -51,11 +51,19 @@ class Profiler:
 
     def __init__(self, filename: str = "profile.json",
                  profile_all: bool = True,
-                 rank: Optional[int] = None):
+                 rank: Optional[int] = None,
+                 max_events: int = 1_000_000):
         self.filename = filename
         self.profile_all = profile_all
         self.rank = rank
         self.running = False
+        # bounded buffer: a profiler left running for a long job must
+        # not grow without limit — past max_events new events are
+        # DROPPED and counted, and the dump metadata reports both
+        # (num_events / dropped_events) so a truncated trace is
+        # self-describing instead of silently partial
+        self.max_events = int(max_events)
+        self._dropped = 0
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
@@ -87,6 +95,13 @@ class Profiler:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def now_us(self) -> float:
+        """The trace clock (microseconds since profiler construction) —
+        the same timebase event ``ts`` values carry, so a caller can
+        mark a window boundary and later attribute only spans recorded
+        after it (``attribute_trace(..., since_us=...)``)."""
+        return self._now_us()
+
     def _tid_locked(self) -> int:
         """Stable small trace-lane id for the calling thread (caller
         holds self._lock)."""
@@ -97,12 +112,20 @@ class Profiler:
             self._tid_names[tid] = threading.current_thread().name
         return tid
 
+    def _append_locked(self, ev: Dict[str, Any]) -> None:
+        """Record one event under the buffer cap (caller holds
+        self._lock): past max_events the event is dropped and counted."""
+        if len(self._events) >= self.max_events:
+            self._dropped += 1
+            return
+        self._events.append(ev)
+
     def add_event(self, name: str, begin_us: float, end_us: float,
                   category: str = "host", args: Optional[Dict] = None):
         if not self.running:
             return
         with self._lock:
-            self._events.append({
+            self._append_locked({
                 "name": name, "cat": category, "ph": "X",
                 "ts": begin_us, "dur": end_us - begin_us,
                 "pid": os.getpid(), "tid": self._tid_locked(),
@@ -121,7 +144,7 @@ class Profiler:
             }
             if args:
                 ev["args"] = dict(args)
-            self._events.append(ev)
+            self._append_locked(ev)
 
     def counter(self, name: str, values: Dict[str, float],
                 category: str = "host"):
@@ -133,7 +156,7 @@ class Profiler:
         if not self.running:
             return
         with self._lock:
-            self._events.append({
+            self._append_locked({
                 "name": name, "cat": category, "ph": "C",
                 "ts": self._now_us(), "pid": os.getpid(),
                 "args": dict(values),
@@ -193,26 +216,42 @@ class Profiler:
         d, b = os.path.split(self.filename)
         return os.path.join(d, f"rank{self.rank}_{b}")
 
+    def to_doc(self) -> Dict[str, Any]:
+        """The trace as a Chrome document (what ``dump`` serializes):
+        events plus lane-name metadata rows, with self-describing
+        accounting in ``metadata`` — ``num_events``/``num_spans`` this
+        trace holds and ``dropped_events`` the buffer cap discarded, so
+        a truncated trace announces its truncation instead of reading
+        as a complete record (the in-process consumer is the step-time
+        attribution layer, telemetry/attribution.py)."""
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._tid_names)
+            dropped = self._dropped
+        pid = os.getpid()
+        num_spans = sum(1 for e in events if e.get("ph") == "X")
+        for tid, tname in sorted(names.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "metadata": {"anchor_unix_us": self._anchor_unix_us,
+                             "rank": self.rank,
+                             "num_events": len(events),
+                             "num_spans": num_spans,
+                             "dropped_events": dropped}}
+
     def dump(self, path: Optional[str] = None) -> str:
         """Write the Chrome trace ATOMICALLY: serialize to a temp file in
         the destination directory and ``os.replace`` it into place, so a
         crash (or a concurrent reader) mid-dump can never observe a
         truncated, unloadable trace.  Thread-name metadata rows label
         each registry-assigned lane; ``metadata.anchor_unix_us`` is the
-        wall-clock anchor ``merge_traces`` aligns cross-party dumps on."""
+        wall-clock anchor ``merge_traces`` aligns cross-party dumps on;
+        ``metadata.num_events``/``num_spans``/``dropped_events`` record
+        the trace's own span accounting (``to_doc``)."""
         path = path or self._dump_path()
-        with self._lock:
-            events = list(self._events)
-            names = dict(self._tid_names)
-        pid = os.getpid()
-        for tid, tname in sorted(names.items()):
-            events.append({"name": "thread_name", "ph": "M", "pid": pid,
-                           "tid": tid, "args": {"name": tname}})
-        doc = {"traceEvents": events, "displayTimeUnit": "ms",
-               "metadata": {"anchor_unix_us": self._anchor_unix_us,
-                            "rank": self.rank}}
         from geomx_tpu.utils.fileio import atomic_json_dump
-        return atomic_json_dump(path, doc)
+        return atomic_json_dump(path, self.to_doc())
 
     def aggregate_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-name {count,total_us,min_us,max_us,avg_us} — the reference's
@@ -236,6 +275,7 @@ class Profiler:
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
+            self._dropped = 0
 
 
 # Process-global profiler, like the reference's Profiler::Get() singleton.

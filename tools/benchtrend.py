@@ -1,0 +1,259 @@
+#!/usr/bin/env python3
+"""benchtrend: gate the repo's committed bench trajectory.
+
+The repo carries its own measurement history — ``BENCH_r*.json``
+(driver-wrapped runs), ``BENCH_CAPTURED_r*.json`` (real hardware
+captures) and ``MULTICHIP_r*.json`` (the 8-device dryrun matrix).
+Until now that history was write-only: a future capture could regress
+throughput or flip the multichip matrix red and nothing would notice
+until a human re-read the numbers.  This tool makes the trajectory a
+gated artifact (ISSUE 8): it extracts the comparable metrics from each
+series, compares the LATEST run against its predecessor, and fails
+loudly on any regression past a noise band.
+
+Comparison rules (deliberately simple and deterministic):
+
+- metrics are compared latest-vs-previous within one series, and only
+  between runs captured on the same ``device_kind`` (a v5e number is
+  not comparable to a CPU smoke number);
+- higher-is-better metrics (samples/sec, mfu) regress when
+  ``latest < (1 - band) * previous``;
+- lower-is-better metrics (step_time_ms) regress when
+  ``latest > (1 + band) * previous``;
+- boolean gates regress on any true -> false flip (MULTICHIP ``ok``,
+  wrapped-run ``rc == 0``) — no band, a red matrix is a failure;
+- a metric present previously but missing in the latest run is
+  reported (``missing``) but does not fail the gate: bench phases are
+  additive across PRs and a renamed field must not brick the repo.
+
+Exit status: 0 when no regression, 1 on any regression, 2 on usage /
+unreadable-series errors.  ``--json`` prints one machine-readable line
+(the CI artifact); default output is a human table.
+
+No jax / no repo imports — stdlib only, same contract as graftlint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_BAND = 0.10
+
+# metric name -> direction ("up" = higher is better, "down" = lower)
+DIRECTION = {
+    "value": "up",
+    "mfu": "up",
+    "samples_per_sec": "up",
+    "step_time_ms": "down",
+    "vs_baseline": "up",
+}
+
+
+def _round_key(path: str) -> Tuple[str, int]:
+    """("BENCH_CAPTURED", 5) from ".../BENCH_CAPTURED_r05.json"."""
+    base = os.path.basename(path)
+    m = re.match(r"([A-Z_]+)_r(\d+)\.json$", base)
+    if not m:
+        return (base, -1)
+    return (m.group(1), int(m.group(2)))
+
+
+def _load(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def extract_metrics(doc: dict) -> Dict[str, Any]:
+    """Flatten one run document into {metric_name: scalar}.
+
+    Handles all three series shapes: a bare bench record, a driver
+    wrapper (``parsed`` holds the record, ``rc`` the exit), and the
+    multichip matrix record (``ok``/``rc``/``n_devices``).
+    """
+    out: Dict[str, Any] = {}
+    rec = doc
+    if "parsed" in doc:                     # driver-wrapped BENCH_r*
+        out["rc_ok"] = (doc.get("rc") == 0)
+        rec = doc.get("parsed") or {}
+        if not isinstance(rec, dict):
+            rec = {}
+    if "ok" in doc and "n_devices" in doc:  # MULTICHIP_r*
+        out["ok"] = bool(doc.get("ok"))
+        out["rc_ok"] = (doc.get("rc") == 0)
+        if not doc.get("skipped"):
+            out["n_devices"] = doc.get("n_devices")
+        return out
+
+    dev = rec.get("device") or {}
+    if isinstance(dev, dict) and dev.get("device_kind"):
+        out["device_kind"] = dev["device_kind"]
+    for k in ("value", "mfu", "vs_baseline"):
+        v = rec.get(k)
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    if rec.get("error"):
+        out["run_errored"] = True
+    configs = rec.get("configs")
+    if isinstance(configs, dict):
+        for cname, crec in sorted(configs.items()):
+            if not isinstance(crec, dict):
+                continue
+            for k in ("samples_per_sec", "step_time_ms", "mfu"):
+                v = crec.get(k)
+                if isinstance(v, (int, float)):
+                    out[f"configs.{cname}.{k}"] = float(v)
+    return out
+
+
+def _direction(metric: str) -> Optional[str]:
+    return DIRECTION.get(metric.rsplit(".", 1)[-1])
+
+
+def compare_series(runs: List[Tuple[str, Dict[str, Any]]],
+                   band: float) -> List[dict]:
+    """Compare the latest run against its predecessor.  ``runs`` is
+    ordered oldest -> newest ``(path, metrics)``.  Returns one verdict
+    dict per comparable metric."""
+    if len(runs) < 2:
+        return []
+    prev_path, prev = runs[-2]
+    last_path, last = runs[-1]
+    verdicts: List[dict] = []
+    dk_prev, dk_last = prev.get("device_kind"), last.get("device_kind")
+    comparable_device = (dk_prev is None or dk_last is None
+                         or dk_prev == dk_last)
+    for metric in sorted(set(prev) | set(last)):
+        if metric in ("device_kind", "run_errored"):
+            continue
+        pv, lv = prev.get(metric), last.get(metric)
+        v: Dict[str, Any] = {"metric": metric, "previous": pv,
+                             "latest": lv, "prev_run": prev_path,
+                             "latest_run": last_path}
+        if isinstance(pv, bool) or isinstance(lv, bool):
+            # boolean gate: true -> false is a regression, no band
+            if pv is True and lv is False:
+                v["status"] = "regression"
+            elif lv is None:
+                v["status"] = "missing"
+            else:
+                v["status"] = "ok"
+            verdicts.append(v)
+            continue
+        direction = _direction(metric)
+        if direction is None or pv is None:
+            continue
+        if lv is None:
+            v["status"] = "missing"
+            verdicts.append(v)
+            continue
+        if not comparable_device:
+            v["status"] = "skipped_device_mismatch"
+            v["devices"] = [dk_prev, dk_last]
+            verdicts.append(v)
+            continue
+        if pv == 0:
+            v["status"] = "ok"
+            verdicts.append(v)
+            continue
+        change = (lv - pv) / abs(pv)
+        v["change"] = round(change, 4)
+        v["band"] = band
+        regressed = (change < -band) if direction == "up" \
+            else (change > band)
+        v["status"] = "regression" if regressed else "ok"
+        verdicts.append(v)
+    return verdicts
+
+
+def run(repo_dir: str, band: float = DEFAULT_BAND,
+        patterns: Optional[List[str]] = None) -> dict:
+    patterns = patterns or ["BENCH_CAPTURED_r*.json", "BENCH_r*.json",
+                            "MULTICHIP_r*.json"]
+    series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+    unreadable: List[str] = []
+    for pat in patterns:
+        for path in sorted(glob.glob(os.path.join(repo_dir, pat)),
+                           key=_round_key):
+            doc = _load(path)
+            name = _round_key(path)[0]
+            if doc is None:
+                unreadable.append(path)
+                continue
+            series.setdefault(name, []).append(
+                (os.path.basename(path), extract_metrics(doc)))
+    all_verdicts: Dict[str, List[dict]] = {}
+    regressions: List[dict] = []
+    for name, runs_ in sorted(series.items()):
+        verdicts = compare_series(runs_, band)
+        all_verdicts[name] = verdicts
+        regressions.extend(v for v in verdicts
+                           if v["status"] == "regression")
+    return {
+        "tool": "benchtrend",
+        "band": band,
+        "series": {name: [p for p, _ in runs_]
+                   for name, runs_ in sorted(series.items())},
+        "verdicts": all_verdicts,
+        "regressions": regressions,
+        "unreadable": unreadable,
+        "passed": not regressions and not unreadable,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="benchtrend",
+        description="Gate the repo's committed bench series on "
+                    "regressions past a noise band.")
+    ap.add_argument("--repo-dir", default=".",
+                    help="directory holding the *_rNN.json series")
+    ap.add_argument("--band", type=float, default=DEFAULT_BAND,
+                    help=f"relative noise band (default {DEFAULT_BAND})")
+    ap.add_argument("--pattern", action="append", default=None,
+                    help="series glob (repeatable; default the three "
+                         "committed families)")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line on stdout")
+    args = ap.parse_args(argv)
+    if args.band < 0:
+        print("benchtrend: --band must be >= 0", file=sys.stderr)
+        return 2
+    report = run(args.repo_dir, band=args.band, patterns=args.pattern)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        for name, verdicts in sorted(report["verdicts"].items()):
+            runs_ = report["series"][name]
+            print(f"{name}: {len(runs_)} runs "
+                  f"({runs_[0]} .. {runs_[-1]})" if runs_ else
+                  f"{name}: no runs")
+            for v in verdicts:
+                mark = {"ok": " ", "regression": "!",
+                        "missing": "?"}.get(v["status"], "-")
+                change = (f" {v['change']:+.1%}"
+                          if "change" in v else "")
+                print(f"  [{mark}] {v['metric']}: "
+                      f"{v['previous']} -> {v['latest']}{change} "
+                      f"({v['status']})")
+        for path in report["unreadable"]:
+            print(f"  [!] unreadable series file: {path}")
+        print("benchtrend:", "PASS" if report["passed"] else "FAIL")
+    if report["regressions"]:
+        return 1
+    if report["unreadable"]:
+        return 2  # infrastructure breakage, not a performance regression
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
