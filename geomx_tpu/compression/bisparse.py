@@ -119,18 +119,38 @@ class BiSparseCompressor(Compressor):
         """Momentum-corrected top-k selection with error feedback.
 
         Returns (values[k], indices[k], new_u, new_v).
+
+        Graft Pilot ratio retuning (control/, docs/control.md): when a
+        control context is open, the EFFECTIVE selection count is
+        ``eff_k = round(k * scale)`` with ``scale`` a TRACED scalar
+        operand — the wire buffers stay ``k`` slots (static shapes, no
+        recompile; the configured ratio is the capacity), unemitted
+        slots ride as sentinels, and the unsent mass stays in the
+        error-feedback buffers exactly as an under-full sampled scan
+        leaves it.  With no context open (``GEOMX_CONTROL=0``) this
+        method traces byte-identically to the pre-control build.
         """
+        from geomx_tpu.control.actuators import current_ratio_scale
         from geomx_tpu.telemetry.probes import record_inline
         n = g_flat.shape[0]
         k = self.k_for(n)
+        scale = current_ratio_scale()
+        eff_k = None
+        if scale is not None:
+            eff_k = jnp.clip(jnp.round(k * scale), 1.0,
+                             float(k)).astype(jnp.int32)
         if self.fused_select:
             # one VMEM-resident pass: momentum math, boundary select,
             # fixed-k pack and EF reset fused (ops/bsc_pallas.py); only
-            # the ~8k-element threshold probe runs in XLA
+            # the ~8k-element threshold probe runs in XLA.  A traced
+            # eff_k raises the sampled boundary so the kernel emits
+            # ~eff_k pairs — the kernel itself is untouched (thr was
+            # always an operand).
             from geomx_tpu.ops.bsc_pallas import (bsc_select_pack,
                                                   sampled_boundary_guv)
             from geomx_tpu.utils.profiler import profile_scope
-            thr = sampled_boundary_guv(g_flat, u, v, k)
+            thr = sampled_boundary_guv(g_flat, u, v,
+                                       k if eff_k is None else eff_k)
             with profile_scope("bsc/select_pack", category="kernel",
                               args={"n": n, "k": k}):
                 vals, idx, u, v = bsc_select_pack(
@@ -147,9 +167,13 @@ class BiSparseCompressor(Compressor):
         absv = jnp.abs(v)
         if self.select == "sampled":
             # the reference's own algorithm (sampled boundary + one
-            # zipping scan, gc.cc:219-259) — O(n), no sort/top-k
-            from geomx_tpu.ops.sampled_topk import sampled_threshold_select
-            vals, idx, keep = sampled_threshold_select(v, absv, k)
+            # zipping scan, gc.cc:219-259) — O(n), no sort/top-k.  The
+            # control plane's eff_k only moves the boundary quantile
+            # (a traced gather index); the scan's shapes are untouched.
+            from geomx_tpu.ops.sampled_topk import (sampled_boundary,
+                                                    sampled_threshold_select)
+            thr = None if eff_k is None else sampled_boundary(absv, eff_k)
+            vals, idx, keep = sampled_threshold_select(v, absv, k, thr=thr)
             # error feedback: emitted coordinates reset (gc.cc:250-252)
             v = jnp.where(keep, 0.0, v)
             u = jnp.where(keep, 0.0, u)
@@ -160,13 +184,28 @@ class BiSparseCompressor(Compressor):
             _, idx = lax.approx_max_k(absv, k)
         else:
             _, idx = lax.top_k(absv, k)
+        idx = idx.astype(jnp.int32)
+        if eff_k is not None:
+            # ranked selection under a traced eff_k: slots past eff_k
+            # become sentinels BEFORE error feedback, so the mass they
+            # would have carried stays in u/v (out-of-range scatter
+            # coordinates drop instead of clamping onto element n-1)
+            keepslot = jnp.arange(k, dtype=jnp.int32) < eff_k
+            vals = jnp.where(keepslot, v[idx], 0.0)
+            sent = jnp.where(keepslot, idx, n).astype(jnp.int32)
+            v = v.at[sent].set(0.0, mode="drop")
+            u = u.at[sent].set(0.0, mode="drop")
+            out_idx = jnp.where(keepslot, idx, -1).astype(jnp.int32)
+            record_inline("bsc_emitted_fraction",
+                          lambda: jnp.sum(out_idx >= 0) / k)
+            return vals, out_idx, u, v
         vals = v[idx]
         # error feedback: sent coordinates reset in both buffers (gc.cc:250-252)
         v = v.at[idx].set(0.0)
         u = u.at[idx].set(0.0)
         # exact/approx top-k always fills all k slots
         record_inline("bsc_emitted_fraction", lambda: jnp.ones((), jnp.float32))
-        return vals, idx.astype(jnp.int32), u, v
+        return vals, idx, u, v
 
     def decompress(self, vals: jax.Array, idx: jax.Array, n: int) -> jax.Array:
         """Scatter-add (value, index) pairs into a dense vector

@@ -159,6 +159,25 @@ class GeoConfig:
     # only log ("info" | "warning" | "error")
     audit_severity: str = "error"
 
+    # ---- closed-loop WAN control (control/: the Graft Pilot;
+    # docs/control.md).  Off by default.  When on, the Trainer threads a
+    # control-operand subtree through sync_state (the bsc ratio scale
+    # rides the traced step as a SCALAR OPERAND, so retuning it never
+    # recompiles) and Trainer.apply_control becomes the actuation
+    # boundary for pipeline-depth / relay decisions.  With control off
+    # the step jaxpr is byte-identical to a controller-excised build
+    # (same hard guarantee as GEOMX_TELEMETRY).
+    control: bool = False
+    # steps between controller evaluations (GraftPilot.tick no-ops on
+    # non-multiples)
+    control_interval: int = 1
+    # absolute bsc-ratio operating range "lo,hi" for the ratio policy;
+    # "" derives [configured_ratio/8, configured_ratio] (the configured
+    # ratio is the wire CAPACITY — the traced scale only tunes downward)
+    control_ratio_bounds: str = ""
+    # minimum steps between two actuations of the same knob
+    control_cooldown: int = 5
+
     # ---- resilience (resilience/: membership epochs, degraded-mode sync,
     # deterministic chaos; docs/resilience.md)
     # residual policy at a membership change: "reset" re-initializes
@@ -222,6 +241,11 @@ class GeoConfig:
             flight_dir=_env(["GEOMX_FLIGHT_DIR"], "", str),
             audit=_env_bool(["GEOMX_AUDIT"], False),
             audit_severity=_env(["GEOMX_AUDIT_SEVERITY"], "error", str),
+            control=_env_bool(["GEOMX_CONTROL"], False),
+            control_interval=_env(["GEOMX_CONTROL_INTERVAL"], 1, int),
+            control_ratio_bounds=_env(
+                ["GEOMX_CONTROL_RATIO_BOUNDS"], "", str),
+            control_cooldown=_env(["GEOMX_CONTROL_COOLDOWN"], 5, int),
             resilience_residuals=_env(
                 ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
             resilience_min_live=_env(

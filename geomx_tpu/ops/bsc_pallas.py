@@ -79,22 +79,24 @@ def fused_kernels_enabled() -> bool:
     return default_on_tpu("GEOMX_FUSED_KERNELS")
 
 
-def sampled_boundary_guv(g: jax.Array, u: jax.Array, v: jax.Array, k: int,
+def sampled_boundary_guv(g: jax.Array, u: jax.Array, v: jax.Array, k,
                          sample: int = 8192):
     """The sampled magnitude boundary computed WITHOUT materializing the
     dense momentum-corrected tensor: gathers the ~``sample`` probe
     positions of g/u/v and applies the momentum arithmetic to just those
     — the full ``|v + (0.9u + g)|`` lives only inside the fused kernel.
-    Same quantile rule as ``ops.sampled_topk.sampled_boundary``."""
-    from geomx_tpu.ops.sampled_topk import sample_positions
+    Same quantile rule as ``ops.sampled_topk.sampled_boundary``; ``k``
+    may be a traced scalar (the control plane's effective-k operand) —
+    the boundary position becomes a traced gather index, the kernel's
+    static shapes never change."""
+    from geomx_tpu.ops.sampled_topk import boundary_position, sample_positions
 
     n = g.shape[0]
     pos = jnp.asarray(sample_positions(n, sample), jnp.int32)
     samp = jnp.abs(v[pos] + (u[pos] * MOMENTUM + g[pos]))
     m = samp.shape[0]
     ssorted = jnp.sort(samp)
-    p = int(round(m * (1.0 - int(k) / n)))
-    return ssorted[min(max(p, 0), m - 1)]
+    return ssorted[boundary_position(m, k, n)]
 
 
 def _ex_cumsum_flat(mask):

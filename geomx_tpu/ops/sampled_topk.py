@@ -36,18 +36,32 @@ def sample_positions(n: int, sample: int = 8192) -> np.ndarray:
     return (np.arange(m, dtype=np.int64) * 2654435761) % n
 
 
-def sampled_boundary(absv: jax.Array, k: int, sample: int = 8192):
+def boundary_position(m: int, k, n: int):
+    """Index into the sorted ``m``-element probe for the (1 - k/n)
+    quantile.  A Python-int ``k`` resolves statically (the jaxpr stays
+    byte-identical to the historical static path — the telemetry-style
+    identity guarantee GEOMX_CONTROL=0 pins); a TRACED ``k`` (the Graft
+    Pilot's no-recompile ratio operand, control/actuators.py) returns a
+    traced position the gather below consumes without a shape change."""
+    if isinstance(k, (int, np.integer)):
+        return min(max(int(round(m * (1.0 - int(k) / n))), 0), m - 1)
+    pos = jnp.round(m * (1.0 - k.astype(jnp.float32) / n))
+    return jnp.clip(pos, 0, m - 1).astype(jnp.int32)
+
+
+def sampled_boundary(absv: jax.Array, k, sample: int = 8192):
     """The sampled magnitude boundary: the (1 - k/n) quantile of a
     sorted ~``sample``-element probe of ``absv``.  Shared by the jnp
     reference scan below and the fused Pallas kernel
     (ops/bsc_pallas.bsc_select_pack), so both paths select against the
-    bit-identical threshold."""
+    bit-identical threshold.  ``k`` may be a traced scalar (see
+    :func:`boundary_position`); the probe positions and output shape
+    never depend on it."""
     n = absv.shape[0]
     m = min(n, int(sample))
     samp = absv[jnp.asarray(sample_positions(n, sample), jnp.int32)]
     ssorted = jnp.sort(samp)
-    pos = int(round(m * (1.0 - int(k) / n)))
-    return ssorted[min(max(pos, 0), m - 1)]
+    return ssorted[boundary_position(m, k, n)]
 
 
 def sampled_threshold_select(v: jax.Array, absv: jax.Array, k: int,

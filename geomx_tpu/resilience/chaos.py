@@ -26,8 +26,26 @@ Spec format (semicolon-separated events; see docs/resilience.md):
     readmit@<step>:party=<p>                explicit re-admission
     drop@<step>:rate=<pct>[,steps=<n>]      message-drop epoch (host
                                             transports; cleared after n)
+    throttle@<step>:party=<p>,factor=<f>[,steps=<n>]
+                                            link-quality shaping: party
+                                            p's WAN uplink throughput is
+                                            multiplied by f (0 < f <= 1;
+                                            0.125 = 8x slower), cleared
+                                            after n steps when given
+    delay@<step>:party=<p>,ms=<m>[,steps=<n>]
+                                            link-quality shaping: m ms
+                                            of added latency per WAN
+                                            round on party p's link
 
 Example: ``"seed=7;blackout@3:party=1,steps=4;drop@10:rate=30,steps=5"``.
+
+``throttle``/``delay`` ride the same in-process transport hook pattern
+``drop`` uses (``protocol.set_link_shaping_override`` next to
+``set_drop_rate_override``): the server's relay hop sleeps the shaped
+extra time inside its ``RelayToGlobal`` span, so WAN *degradation* —
+not just blackout/loss — is deterministically replayable, and the
+LinkObservatory measures exactly what the schedule injected (the
+controller acceptance harness of ``bench.py --compare-control``).
 
 Determinism contract: the same spec (or the same ``random`` arguments)
 produces the same event sequence, and the engine reseeds the protocol
@@ -42,18 +60,21 @@ import dataclasses
 import random as _random
 from typing import Iterable, List, Optional, Tuple
 
-# event kinds after duration expansion (a blackout/flap/drop WITH a
-# ``steps=`` window expands into its paired restore event at build time,
-# so the engine itself is a stateless replayer)
-_KINDS = ("blackout", "readmit", "drop_rate", "drop_clear")
+# event kinds after duration expansion (a blackout/flap/drop/throttle/
+# delay WITH a ``steps=`` window expands into its paired restore event
+# at build time, so the engine itself is a stateless replayer)
+_KINDS = ("blackout", "readmit", "drop_rate", "drop_clear",
+          "throttle", "throttle_clear", "delay", "delay_clear")
 
 
 @dataclasses.dataclass(frozen=True, order=True)
 class ChaosEvent:
     step: int
     kind: str          # one of _KINDS
-    party: int = -1    # blackout/readmit
+    party: int = -1    # blackout/readmit/throttle/delay
     rate: int = 0      # drop_rate, percent 0-100
+    factor: float = 0.0  # throttle: throughput multiplier (0 < f <= 1)
+    ms: int = 0        # delay: added latency per WAN round
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -87,8 +108,17 @@ class ChaosSchedule:
                 parts.append(f"{e.kind}@{e.step}:party={e.party}")
             elif e.kind == "drop_rate":
                 parts.append(f"drop@{e.step}:rate={e.rate}")
-            else:  # drop_clear
+            elif e.kind == "drop_clear":
                 parts.append(f"dropclear@{e.step}")
+            elif e.kind == "throttle":
+                parts.append(
+                    f"throttle@{e.step}:party={e.party},factor={e.factor:g}")
+            elif e.kind == "throttle_clear":
+                parts.append(f"throttleclear@{e.step}:party={e.party}")
+            elif e.kind == "delay":
+                parts.append(f"delay@{e.step}:party={e.party},ms={e.ms}")
+            else:  # delay_clear
+                parts.append(f"delayclear@{e.step}:party={e.party}")
         return ";".join(parts)
 
     # ---- constructors ------------------------------------------------------
@@ -120,12 +150,18 @@ class ChaosSchedule:
                 k, _, v = item.partition("=")
                 if not _:
                     raise ValueError(f"bad chaos option {item!r} in {raw!r}")
-                kv[k] = int(v)
+                # every option is an integer except the throttle factor,
+                # which is a throughput multiplier in (0, 1]
+                kv[k] = float(v) if k == "factor" else int(v)
             known = {"blackout": {"party", "steps"},
                      "flap": {"party", "steps"},
                      "readmit": {"party"},
                      "drop": {"rate", "steps"},
-                     "dropclear": set()}
+                     "dropclear": set(),
+                     "throttle": {"party", "factor", "steps"},
+                     "throttleclear": {"party"},
+                     "delay": {"party", "ms", "steps"},
+                     "delayclear": {"party"}}
             if kind not in known:
                 raise ValueError(f"unknown chaos kind {kind!r}; valid: "
                                  f"{sorted(known)}")
@@ -151,6 +187,33 @@ class ChaosSchedule:
                 if kv.get("steps"):
                     events.append(ChaosEvent(step + kv["steps"],
                                              "drop_clear"))
+            elif kind == "throttle":
+                factor = kv["factor"]
+                if not 0.0 < factor <= 1.0:
+                    raise ValueError(
+                        f"throttle factor {factor} not in (0, 1]")
+                events.append(ChaosEvent(step, "throttle",
+                                         party=kv["party"], factor=factor))
+                if kv.get("steps"):
+                    events.append(ChaosEvent(int(step + kv["steps"]),
+                                             "throttle_clear",
+                                             party=kv["party"]))
+            elif kind == "throttleclear":
+                events.append(ChaosEvent(step, "throttle_clear",
+                                         party=kv["party"]))
+            elif kind == "delay":
+                ms = kv["ms"]
+                if ms < 0:
+                    raise ValueError(f"delay ms {ms} must be >= 0")
+                events.append(ChaosEvent(step, "delay",
+                                         party=kv["party"], ms=ms))
+                if kv.get("steps"):
+                    events.append(ChaosEvent(int(step + kv["steps"]),
+                                             "delay_clear",
+                                             party=kv["party"]))
+            elif kind == "delayclear":
+                events.append(ChaosEvent(step, "delay_clear",
+                                         party=kv["party"]))
             else:  # dropclear
                 events.append(ChaosEvent(step, "drop_clear"))
         return cls(events, seed=seed)
@@ -227,17 +290,34 @@ class ChaosEngine:
                 self.controller.mark_dead(e.party)
             else:
                 self.controller.mark_live(e.party)
-        elif self.drive_drop_hook:
+        elif not self.drive_drop_hook:
+            return
+        elif e.kind in ("drop_rate", "drop_clear"):
             from geomx_tpu.service.protocol import set_drop_rate_override
             set_drop_rate_override(e.rate if e.kind == "drop_rate" else None)
+        else:
+            # link-quality shaping: same in-process hook pattern as the
+            # drop override — the transports consult it, the engine
+            # installs/clears it on schedule
+            from geomx_tpu.service.protocol import set_link_shaping_override
+            if e.kind == "throttle":
+                set_link_shaping_override(e.party, factor=e.factor)
+            elif e.kind == "throttle_clear":
+                set_link_shaping_override(e.party, factor=None)
+            elif e.kind == "delay":
+                set_link_shaping_override(e.party, delay_ms=e.ms)
+            else:  # delay_clear
+                set_link_shaping_override(e.party, delay_ms=None)
 
     def close(self) -> None:
-        """Clear any installed drop override (idempotent) — pair with
-        construction in tests so one chaos run cannot leak loss into the
-        next."""
+        """Clear any installed drop/shaping override (idempotent) — pair
+        with construction in tests so one chaos run cannot leak loss or
+        link degradation into the next."""
         if self.drive_drop_hook:
-            from geomx_tpu.service.protocol import set_drop_rate_override
+            from geomx_tpu.service.protocol import (
+                clear_link_shaping_overrides, set_drop_rate_override)
             set_drop_rate_override(None)
+            clear_link_shaping_overrides()
 
     def __enter__(self) -> "ChaosEngine":
         return self

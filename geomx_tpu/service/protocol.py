@@ -141,6 +141,77 @@ def reseed_drop_rng(seed: int) -> None:
     _drop_rng.seed(seed)
 
 
+# chaos link-quality shaping (resilience/chaos.py `throttle@`/`delay@`):
+# per-party overrides the in-process transports consult, installed and
+# cleared by the chaos engine exactly like the drop-rate override above.
+# ``factor`` multiplies the link's effective throughput (0 < f <= 1
+# slows it; 0.125 models an 8x-degraded uplink), ``delay_ms`` adds
+# fixed latency per WAN round.  The server's relay hop turns these into
+# real extra wall-clock inside its RelayToGlobal span, so the
+# LinkObservatory *measures* the degradation the schedule injected —
+# which is what makes a chaos replay a controller acceptance harness.
+_link_shaping: "dict[int, dict]" = {}
+
+_SHAPE_KEEP = object()  # "argument not passed": keep the installed value
+
+
+def set_link_shaping_override(party, factor=_SHAPE_KEEP,
+                              delay_ms=_SHAPE_KEEP) -> None:
+    """Install per-party link shaping.  A component you do not pass is
+    left as installed (throttle and delay compose on one party);
+    passing ``None`` clears that component, and an entry with neither
+    component is removed entirely."""
+    p = int(party)
+    ent = dict(_link_shaping.get(p, {}))
+    if factor is not _SHAPE_KEEP:
+        if factor is None:
+            ent.pop("factor", None)
+        else:
+            f = float(factor)
+            if not 0.0 < f:
+                raise ValueError(
+                    f"throttle factor must be > 0 (got {factor!r})")
+            ent["factor"] = f
+    if delay_ms is not _SHAPE_KEEP:
+        if delay_ms is None:
+            ent.pop("delay_ms", None)
+        else:
+            d = float(delay_ms)
+            if d < 0:
+                raise ValueError(f"delay_ms must be >= 0 (got {delay_ms!r})")
+            ent["delay_ms"] = d
+    if ent:
+        _link_shaping[p] = ent
+    else:
+        _link_shaping.pop(p, None)
+
+
+def get_link_shaping(party) -> dict:
+    """The active shaping entry for ``party`` ({} when unshapen)."""
+    return dict(_link_shaping.get(int(party), {}))
+
+
+def clear_link_shaping_overrides() -> None:
+    """Remove every shaping override (chaos-engine close / test
+    isolation)."""
+    _link_shaping.clear()
+
+
+def shaping_extra_seconds(party, base_seconds: float = 0.0) -> float:
+    """Artificial extra wall-clock for a WAN round on ``party``'s link
+    that genuinely took ``base_seconds``: the configured fixed delay
+    plus the slowdown a throughput factor implies
+    (``base * (1/factor - 1)``).  0.0 when the link is unshapen."""
+    ent = _link_shaping.get(int(party))
+    if not ent:
+        return 0.0
+    extra = ent.get("delay_ms", 0.0) / 1e3
+    f = ent.get("factor")
+    if f is not None and f < 1.0:
+        extra += max(base_seconds, 0.0) * (1.0 / f - 1.0)
+    return extra
+
+
 def env_int(names, default: int) -> int:
     """First-set env var among `names` wins (shared config._env parser, so
     unparseable values raise like every other GEOMX_* knob)."""

@@ -195,6 +195,23 @@ class GeoScheduler:
                     h.send_header("Content-Length", str(len(body)))
                     h.end_headers()
                     h.wfile.write(body)
+                elif route == "/control":
+                    # Graft Pilot decision history (control/actuators.py,
+                    # docs/control.md): the bounded process-global log of
+                    # applied actuations — what the controller changed,
+                    # when, and why
+                    from geomx_tpu.control.actuators import \
+                        get_decision_log
+                    log = get_decision_log()
+                    body = _json.dumps({
+                        "decisions": log.snapshot(),
+                        "total": log.total,
+                        "capacity": log.capacity}).encode("utf-8")
+                    h.send_response(200)
+                    h.send_header("Content-Type", "application/json")
+                    h.send_header("Content-Length", str(len(body)))
+                    h.end_headers()
+                    h.wfile.write(body)
                 else:
                     h.send_response(404)
                     h.end_headers()

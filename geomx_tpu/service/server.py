@@ -865,12 +865,25 @@ class GeoPSServer:
         (DataPushToGlobalServers* + DataPullFromGlobalServers*).
         ``round_`` tags the span for cross-party round correlation;
         ``payload_bytes`` makes the span a throughput observation the
-        LinkObservatory (telemetry/links.py) can fold on replay."""
+        LinkObservatory (telemetry/links.py) can fold on replay.
+
+        Chaos link shaping (``throttle@``/``delay@``,
+        resilience/chaos.py): any installed override for this party is
+        realized as real extra wall-clock INSIDE the span, so the
+        degradation a schedule injects is the degradation the
+        observatory measures."""
+        from geomx_tpu.service.protocol import shaping_extra_seconds
         with self.profiler.scope(
                 f"RelayToGlobal:{key}", "comm",
                 args={"key": key, "round_id": round_,
                       "payload_bytes": int(np.asarray(grad).nbytes)}):
-            return self._relay_to_global_impl(key, grad)
+            t0 = time.monotonic()
+            out = self._relay_to_global_impl(key, grad)
+            extra = shaping_extra_seconds(self.rank,
+                                          time.monotonic() - t0)
+            if extra > 0:
+                time.sleep(extra)
+            return out
 
     def _relay_to_global_impl(self, key: str, grad: np.ndarray) -> np.ndarray:
         place = self._gplace.get(key)

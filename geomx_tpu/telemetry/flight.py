@@ -85,7 +85,8 @@ class FlightRecorder:
                  density_drift: float = 0.5,
                  exposed_jump: float = 0.25,
                  min_history: int = 5,
-                 window: int = 64):
+                 window: int = 64,
+                 decision_capacity: int = 64):
         if capacity <= 0:
             raise ValueError(f"capacity must be > 0 (got {capacity!r})")
         self.capacity = int(capacity)
@@ -97,6 +98,13 @@ class FlightRecorder:
         self.window = int(window)
         self._ring: "collections.deque[dict]" = collections.deque(
             maxlen=self.capacity)
+        # controller actuations (control/actuators.py): a bounded
+        # sibling ring so a forensics bundle shows the last N decisions
+        # alongside the step records — "the density drifted at step 412"
+        # reads very differently next to "the pilot lowered the ratio at
+        # step 410"
+        self._decisions: "collections.deque[dict]" = collections.deque(
+            maxlen=max(1, int(decision_capacity)))
         self.dumps: List[str] = []    # bundle paths written so far
         self.anomalies_seen = 0
 
@@ -131,6 +139,15 @@ class FlightRecorder:
 
     def snapshot(self) -> List[dict]:
         return list(self._ring)
+
+    def record_decision(self, decision: Dict[str, Any]) -> None:
+        """Append one controller actuation (a Decision's JSON form) to
+        the bounded decision ring; it rides every subsequent forensics
+        bundle."""
+        self._decisions.append(dict(decision))
+
+    def decisions(self) -> List[dict]:
+        return list(self._decisions)
 
     # ---- anomaly rules (pure functions of ring + new record) ---------------
 
@@ -230,6 +247,7 @@ class FlightRecorder:
             "poisoned_parties": poisoned,
             "trigger": rec,
             "ring": self.snapshot(),
+            "decisions": self.decisions(),
             "capacity": self.capacity,
         }
         from geomx_tpu.utils.fileio import atomic_json_dump

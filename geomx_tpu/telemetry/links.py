@@ -73,6 +73,24 @@ class LinkEstimate:
                     self.throughput_bps, nbytes / seconds, alpha)
 
 
+def relay_order(records, peer: str = "global",
+                min_confidence: float = 0.0) -> list:
+    """Widest-uplink-first party order over snapshot records — THE
+    relay ordering rule (throughput descending, unmeasured links last,
+    ties broken by party name), shared by
+    :meth:`LinkObservatory.best_relay_order` and the control plane's
+    ``RelayPolicy`` so the published order and the policy's chain can
+    never drift.  ``records``: snapshot-record dicts (``party`` /
+    ``peer`` / ``throughput_bps`` / ``confidence``)."""
+    entries = [r for r in records if r["peer"] == peer
+               and r["confidence"] >= min_confidence]
+    entries.sort(key=lambda r: (
+        -(r["throughput_bps"]
+          if r["throughput_bps"] is not None else -math.inf),
+        r["party"]))
+    return [r["party"] for r in entries]
+
+
 class LinkObservatory:
     """Fold WAN round observations into per-link quality estimates.
 
@@ -157,12 +175,18 @@ class LinkObservatory:
 
     # ---- read side (the controller's sensor interface) ---------------------
 
-    def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
+    def snapshot(self, now: Optional[float] = None,
+                 min_confidence: Optional[float] = None) -> Dict[str, dict]:
         """The current estimate per link, keyed ``"<party>-><peer>"``:
         ``throughput_bps`` / ``rtt_s`` / ``loss_rate`` EWMAs, sample and
         failure counts, and the staleness pair (``age_s``,
         ``confidence`` = 2^(-age/half-life), ``stale`` below 0.5).
-        Deterministic for a given ``now``."""
+        Deterministic for a given ``now``.
+
+        ``min_confidence`` filters out links whose staleness-decayed
+        confidence has fallen below the threshold — the one staleness
+        gate every policy consumer shares instead of re-implementing
+        (docs/control.md)."""
         now = time.time() if now is None else float(now)
         out: Dict[str, dict] = {}
         with self._lock:
@@ -171,6 +195,8 @@ class LinkObservatory:
                     if est.last_t is not None else math.inf
                 conf = 2.0 ** (-age / self.stale_after_s) \
                     if math.isfinite(age) else 0.0
+                if min_confidence is not None and conf < min_confidence:
+                    continue
                 out[f"{party}->{peer}"] = {
                     "party": party, "peer": peer,
                     "throughput_bps": est.throughput_bps,
@@ -184,6 +210,21 @@ class LinkObservatory:
                     "stale": conf < 0.5,
                 }
         return out
+
+    def best_relay_order(self, peer: str = "global",
+                         now: Optional[float] = None,
+                         min_confidence: float = 0.0) -> list:
+        """Parties ordered widest-uplink-first toward ``peer`` — the
+        greedy widest-path relay chain the paper's TSEngine forms
+        (ProcessAsk1Command pairs the lower-throughput node to send
+        through the higher-throughput one; the widest link sits next to
+        the sink).  Deterministic: throughput descending, unmeasured
+        links last, ties broken by party name (:func:`relay_order` —
+        the one ordering rule the control plane's RelayPolicy shares).
+        Links below ``min_confidence`` are excluded up front (same
+        staleness gate as :meth:`snapshot`)."""
+        snap = self.snapshot(now=now, min_confidence=min_confidence or None)
+        return relay_order(snap.values(), peer=peer)
 
     def publish(self, registry=None, now: Optional[float] = None) -> None:
         """Export the snapshot as registry gauges

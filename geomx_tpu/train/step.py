@@ -98,6 +98,15 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     # byte-identical to a build with telemetry excised (pinned by
     # tests/test_telemetry.py)
     telem = _probes.telemetry_enabled(config)
+    # Graft Pilot control operands (control/, docs/control.md): the same
+    # static-gate contract — when GEOMX_CONTROL is on, sync_state
+    # carries a "control" subtree of traced scalar operands (the bsc
+    # ratio scale) that the dc-tier compressors read through a
+    # trace-time context; when off, nothing here traces and the jaxpr is
+    # byte-identical to a controller-excised build (pinned by
+    # tests/test_control.py)
+    from geomx_tpu.control.actuators import control_enabled
+    ctl_on = control_enabled(config)
 
     mgps = None
     if config is not None and getattr(config, "multi_gps", False):
@@ -300,6 +309,23 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         step = state.step
         xb, yb = x[0, 0], y[0, 0]
 
+        ctl = None
+        if ctl_on:
+            # detach the control operands before the sync hooks (whose
+            # state-threading rebuilds dicts and would drop foreign
+            # keys) and open them as a trace-time context for the
+            # compressors; they rejoin the output sync_state below so
+            # host-side actuation rewrites them without a recompile
+            from geomx_tpu.control.actuators import CONTROL_KEY
+            sync_state = dict(sync_state)
+            ctl = sync_state.pop(CONTROL_KEY, None)
+            if ctl is None:
+                raise ValueError(
+                    "GEOMX_CONTROL is on but sync_state carries no "
+                    "control operands: initialize the state with a "
+                    "control-enabled Trainer (init_state adds the "
+                    f"{CONTROL_KEY!r} subtree)")
+
         fwd_params = sync.forward_params(params, sync_state)
         (loss, (model_state, logits)), grads = grad_fn(
             fwd_params, model_state, xb, yb)
@@ -323,7 +349,12 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         synced_grads = None
         probe_ctx = _probes.inline_collection() if telem \
             else contextlib.nullcontext(None)
-        with probe_ctx as inline_sink:
+        if ctl is not None:
+            from geomx_tpu.control.actuators import control_operands
+            ctl_ctx = control_operands(ctl)
+        else:
+            ctl_ctx = contextlib.nullcontext(None)
+        with probe_ctx as inline_sink, ctl_ctx:
             if mgps is not None:
                 params, opt_state, sync_state = _mgps_sync_update(
                     grads, params, opt_state, sync_state, step)
@@ -351,6 +382,12 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
             model_state, sync_state = sync.sync_model_state(model_state,
                                                             sync_state,
                                                             step)
+        if ctl is not None:
+            # operands pass through unchanged (actuation is host-side);
+            # rejoining after the hooks keeps the state structure stable
+            # whatever dicts the algorithm rebuilt
+            from geomx_tpu.control.actuators import CONTROL_KEY
+            sync_state = dict(sync_state, **{CONTROL_KEY: ctl})
 
         acc = jnp.mean(jnp.argmax(logits, -1) == yb)
         metrics = {"loss": loss, "accuracy": acc}
@@ -382,6 +419,13 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
             metrics["telemetry"] = _probes.collect_step_probes(
                 raw_grads, synced_grads, sync, sync_state, inline_sink,
                 params)
+            if ctl is not None:
+                # the live ratio scale rides the probe dict so the
+                # registry (and the controller's own sensors) see the
+                # operand the step actually ran with — replicated by
+                # construction (every device holds the same state copy)
+                metrics["telemetry"]["control_ratio_scale"] = \
+                    ctl["bsc_ratio_scale"]
 
         new_state = TrainState(
             step=step + 1,

@@ -8,6 +8,7 @@ its JSON measurement reporter (examples/utils.py:120-192).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from typing import Callable, Optional
 
@@ -115,6 +116,14 @@ class Trainer:
         # logging (no extra device round trips)
         from geomx_tpu.telemetry.probes import telemetry_enabled
         self._telemetry = telemetry_enabled(self.config)
+        # Graft Pilot (control/, docs/control.md): when enabled, the
+        # sync_state carries traced control operands (init_state adds
+        # them) and apply_control is the actuation boundary — ratio
+        # rewrites are operand swaps (no recompile), depth switches are
+        # cached recompiles modeled on apply_membership
+        from geomx_tpu.control.actuators import control_enabled
+        self._control = control_enabled(self.config)
+        self._control_cache: dict = {}   # (depth, membership) -> step_fn
         # graft auditor (analysis/, docs/analysis.md): when enabled, the
         # fit loop captures the active step program's collective
         # signature once (cheap: one abstract trace) and every
@@ -200,6 +209,19 @@ class Trainer:
             opt_state = self.tx.init(params)
             sync_state = self.sync.init_state(params,
                                               model_state=model_state)
+        if self._control:
+            # control operands join sync_state so they ride the traced
+            # step as INPUTS: retuning them is a host-side rewrite of
+            # one scalar leaf, never a recompile (control/actuators.py)
+            from geomx_tpu.control.actuators import (CONTROL_KEY,
+                                                     init_control_operands)
+            if not isinstance(sync_state, dict):
+                raise ValueError(
+                    "GEOMX_CONTROL needs a dict-shaped sync state to "
+                    f"carry its operands; {self.sync.name!r} returns "
+                    f"{type(sync_state).__name__}")
+            sync_state = dict(sync_state)
+            sync_state[CONTROL_KEY] = init_control_operands()
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params, opt_state=opt_state,
@@ -385,6 +407,190 @@ class Trainer:
             import warnings
             warnings.warn("\n".join(f.format() for f in findings),
                           RuntimeWarning, stacklevel=3)
+
+    # ---- Graft Pilot actuation boundary (control/, docs/control.md) -------
+
+    def _dc_ratio_compressor(self):
+        """The ratio-bearing dc-tier compressor (BiSparse, possibly
+        under MPQ), unwrapped through the Pipelined/Bucketed layers;
+        None when the dc tier carries no top-k ratio."""
+        dc = getattr(self.sync, "dc_compressor", None)
+        if dc is None:
+            dc = getattr(getattr(self.sync, "inner", None),
+                         "dc_compressor", None)
+        while dc is not None and not hasattr(dc, "ratio") \
+                and hasattr(dc, "inner"):
+            dc = dc.inner
+        if dc is not None and not hasattr(dc, "ratio"):
+            # MPQ routes large tensors to its BiSparse half
+            dc = getattr(dc, "large", None)
+        return dc if dc is not None and hasattr(dc, "ratio") else None
+
+    def control_depth(self) -> int:
+        """The pipeline depth currently compiled in (0 or 1)."""
+        from geomx_tpu.sync.pipeline import PipelinedSync
+        return 1 if isinstance(self.sync, PipelinedSync) else 0
+
+    def apply_control(self, state: TrainState, decision) -> TrainState:
+        """Apply one Graft Pilot decision — the control subsystem's
+        actuation boundary (docs/control.md).
+
+        - ``kind == "ratio"``: rewrite the ``bsc_ratio_scale`` operand
+          in ``sync_state["control"]`` host-side with the SAME sharding
+          the compiled step expects — the jit cache stays warm, no
+          recompile (the bench pins the cached-executable count).
+        - ``kind == "depth"``: wrap/unwrap ``PipelinedSync`` — a
+          recompile boundary modeled on :meth:`apply_membership`
+          (per-decision cached step programs; dc-tier error-feedback
+          residuals CARRY across the swap, disabling drains the
+          in-flight aggregate first so no gradient is lost; the
+          collective-consistency audit re-runs on the new program
+          before it is installed when GEOMX_AUDIT is armed).
+
+        Relay decisions are host-plane only and never reach this
+        method (``ControlActuator`` routes them to the transport).
+        """
+        if not self._control:
+            raise ValueError(
+                "apply_control needs GEOMX_CONTROL/GeoConfig(control="
+                "True): the compiled step carries no control operands")
+        kind = getattr(decision, "kind", None)
+        if kind == "ratio":
+            return self._apply_ratio(state, decision)
+        if kind == "depth":
+            return self._apply_depth(state, decision)
+        raise ValueError(f"unknown control decision kind {kind!r}; "
+                         "apply_control handles ratio | depth")
+
+    def _apply_ratio(self, state: TrainState, decision) -> TrainState:
+        from geomx_tpu.control.actuators import CONTROL_KEY
+        comp = self._dc_ratio_compressor()
+        if comp is None:
+            raise ValueError(
+                "ratio decision with no ratio-bearing dc compressor: "
+                "configure bsc/mpq compression (the control scale tunes "
+                "the top-k ratio)")
+        target = float(decision.value)
+        # the configured ratio is the wire CAPACITY — the traced scale
+        # only selects below it (static shapes never change)
+        scale = min(max(target / float(comp.ratio), 1e-6), 1.0)
+        ctl = state.sync_state[CONTROL_KEY]
+        leaf = ctl["bsc_ratio_scale"]
+        new_leaf = jax.device_put(
+            jnp.full(leaf.shape, scale, leaf.dtype), leaf.sharding)
+        new_ctl = dict(ctl, bsc_ratio_scale=new_leaf)
+        return TrainState(
+            step=state.step, params=state.params,
+            opt_state=state.opt_state, model_state=state.model_state,
+            sync_state=dict(state.sync_state, **{CONTROL_KEY: new_ctl}))
+
+    def _apply_depth(self, state: TrainState, decision) -> TrainState:
+        import copy
+
+        from geomx_tpu.control.actuators import CONTROL_KEY
+        from geomx_tpu.sync.pipeline import PipelinedSync
+        target = int(decision.value)
+        if target not in (0, 1):
+            raise ValueError(f"depth decision value must be 0 or 1 "
+                             f"(got {decision.value!r})")
+        current = self.control_depth()
+        if target == current:
+            return state
+        if self._zero_plan is not None or self._mgps is not None:
+            raise ValueError(
+                "depth switching does not compose with GEOMX_ZERO/"
+                "GEOMX_MULTI_GPS: their sharded updates re-layout the "
+                "sync state this transition carries; pin the depth "
+                "statically instead")
+        if self.topology.num_parties <= 1:
+            import warnings
+            warnings.warn("depth decision ignored: num_parties=1 has "
+                          "no dc-tier collective to pipeline",
+                          RuntimeWarning, stacklevel=2)
+            return state
+        if target == 0:
+            # land the in-flight aggregate BEFORE the swap: the parked
+            # gradient applies exactly once, nothing is lost
+            state = self.drain_pipeline(state)
+        params0 = unreplicate_tree(state.params)
+        ms0 = unreplicate_tree(state.model_state)
+        old_ss = dict(unreplicate_tree(state.sync_state))
+        ctl = old_ss.pop(CONTROL_KEY)
+        if target == 1:
+            new_sync = PipelinedSync(
+                self.sync, dcasgd_lambda=self.config.pipeline_dcasgd)
+        else:
+            new_sync = copy.copy(self.sync.inner)
+            # unwrap the PipelinedCompressor installed at wrap time; the
+            # BucketedCompressor underneath (and its layout cache) is
+            # shared, so no re-trace of the bucket layout
+            new_sync.dc_compressor = self.sync.inner.dc_compressor.inner
+        new_sync.bind_topology(self.topology)
+        if self._membership is not None:
+            new_sync.bind_membership(self._membership)
+        # state transition with EF carry: the dc-tier error-feedback
+        # residuals live at the same bucket coordinates on both sides of
+        # the swap — discarding them would replay the parked mass as a
+        # one-off gradient spike
+        fresh = new_sync.init_state(params0, model_state=ms0)
+        if target == 1:
+            inner_fresh = dict(fresh["inner"])
+            for key, val in old_ss.items():
+                if key == "dc_comp":
+                    inner_fresh["dc_comp"] = dict(
+                        inner_fresh["dc_comp"], inner=val)
+                elif key in inner_fresh:
+                    inner_fresh[key] = val
+            fresh = dict(fresh, inner=inner_fresh)
+        else:
+            old_inner = old_ss["inner"]
+            fresh = dict(fresh)
+            for key, val in old_inner.items():
+                if key == "dc_comp":
+                    fresh["dc_comp"] = val["inner"]
+                elif key in fresh:
+                    fresh[key] = val
+        fresh[CONTROL_KEY] = ctl
+        cache_key = (target, self._membership)
+        step_fn = self._control_cache.get(cache_key)
+        if step_fn is None:
+            step_fn = build_train_step(
+                self.loss_fn, self.tx, new_sync, self.topology,
+                self.mesh, donate=self._donate, config=self.config,
+                sp_model=self._sp_model)
+            self._control_cache[cache_key] = step_fn
+        new_state = TrainState(
+            step=state.step, params=state.params,
+            opt_state=state.opt_state, model_state=state.model_state,
+            sync_state=replicate_tree(fresh, self.topology, self.mesh))
+        # collective-signature audit across the swap (analysis/): the
+        # new program's own cross-party consistency findings gate BEFORE
+        # it is installed — a depth change legitimately changes the
+        # collective sequence, so the diff-vs-reference check is
+        # re-ARMED on the new program rather than diffed across depths
+        if self._audit and self._audit_args is not None:
+            _, xb_s, yb_s = self._audit_args
+            self._audit_args = (jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                new_state), xb_s, yb_s)
+            sig, findings = self._step_signature(step_fn)
+            from geomx_tpu.analysis import enforce
+            leftover = enforce(list(findings), self._audit_gate)
+            if leftover:
+                import warnings
+                warnings.warn("\n".join(f.format() for f in leftover),
+                              RuntimeWarning, stacklevel=2)
+            self._audit_sigs = {self._membership: (sig, findings)}
+        # install: the new sync owns the dc compressor stack from here;
+        # membership/epoch caches built against the old program drop
+        self.sync = new_sync
+        self.train_step = step_fn
+        self.config = dataclasses.replace(self.config,
+                                          pipeline_depth=target)
+        self._step_cache = {self._membership: step_fn}
+        self._epoch_runners.clear()
+        self._drain_step = None
+        return new_state
 
     def catchup_payload(self, state: TrainState) -> bytes:
         """The re-admission catch-up blob: one unreplicated copy of the

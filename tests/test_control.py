@@ -1,0 +1,541 @@
+"""Graft Pilot control subsystem (geomx_tpu/control/, docs/control.md).
+
+The contracts under test:
+
+- chaos link-quality shaping: the `throttle@`/`delay@` grammar round-
+  trips, expands into paired clear events, and drives the in-process
+  transport hook (`protocol.set_link_shaping_override`) exactly like
+  `drop@` drives the drop override;
+- LinkObservatory controller surface: `snapshot(min_confidence=)`
+  filters stale links, `best_relay_order()` is the deterministic
+  greedy widest-path chain;
+- policies: ratio retuning moves toward the throughput-matched point
+  with bounded steps, respects the EF accuracy floor, and hysteresis +
+  cooldown prevent oscillation on a noisy trace; depth switching is a
+  Schmitt trigger on the wan fraction; relay forms on margin-clearing
+  asymmetry and releases when it collapses;
+- actuation: a ratio decision changes the achieved emitted fraction
+  WITHOUT a recompile (jit cache pinned); a depth decision is a cached
+  recompile boundary that carries EF state and drains the pipeline;
+  with GEOMX_CONTROL off the step jaxpr is byte-identical to a
+  controller-excised build (the telemetry-style hard guarantee);
+- surfaces: decisions land in the bounded DecisionLog, the flight
+  ring's decision sibling (bundles include them), and the scheduler's
+  `GET /control` endpoint.
+"""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from geomx_tpu.config import GeoConfig
+from geomx_tpu.control import (CONTROL_KEY, ControlActuator,
+                               ControlObservation, ControlSensors, Decision,
+                               DecisionLog, DepthPolicy, GraftPilot,
+                               RatioPolicy, RelayPolicy, control_operands,
+                               current_ratio_scale, reset_decision_log)
+from geomx_tpu.control import actuators as actuators_mod
+from geomx_tpu.models import MLP
+from geomx_tpu.resilience import ChaosEngine, ChaosSchedule
+from geomx_tpu.service import protocol
+from geomx_tpu.sync import get_sync_algorithm
+from geomx_tpu.telemetry import reset_registry
+from geomx_tpu.telemetry.flight import FlightRecorder
+from geomx_tpu.telemetry.links import LinkObservatory
+from geomx_tpu.telemetry.probes import canonicalize_jaxpr
+from geomx_tpu.topology import HiPSTopology
+from geomx_tpu.train import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_shaping():
+    protocol.clear_link_shaping_overrides()
+    yield
+    protocol.clear_link_shaping_overrides()
+
+
+# --------------------------------------------------------------------------
+# chaos link-quality shaping
+# --------------------------------------------------------------------------
+
+def test_throttle_delay_grammar_roundtrip_and_expansion():
+    spec = ("seed=9;throttle@3:party=1,factor=0.25,steps=4;"
+            "delay@5:party=2,ms=120,steps=2")
+    sched = ChaosSchedule.from_spec(spec)
+    kinds = [(e.step, e.kind) for e in sched.events]
+    assert (3, "throttle") in kinds and (7, "throttle_clear") in kinds
+    assert (5, "delay") in kinds and (7, "delay_clear") in kinds
+    # canonical spec round-trips through the parser
+    again = ChaosSchedule.from_spec(sched.spec())
+    assert again.events == sched.events and again.seed == 9
+    thr = next(e for e in sched.events if e.kind == "throttle")
+    assert thr.party == 1 and thr.factor == 0.25
+    with pytest.raises(ValueError):
+        ChaosSchedule.from_spec("throttle@1:party=0,factor=2.0")
+    with pytest.raises(ValueError):
+        ChaosSchedule.from_spec("throttle@1:party=0,rate=5")
+
+
+def test_chaos_engine_drives_link_shaping_hook():
+    sched = ChaosSchedule.from_spec(
+        "throttle@1:party=1,factor=0.5,steps=2;delay@1:party=1,ms=40,steps=2")
+    with ChaosEngine(sched) as engine:
+        engine.tick(0)
+        assert protocol.get_link_shaping(1) == {}
+        engine.tick(1)
+        assert protocol.get_link_shaping(1) == {"factor": 0.5,
+                                                "delay_ms": 40.0}
+        engine.tick(3)  # both windows end at step 3
+        assert protocol.get_link_shaping(1) == {}
+        protocol.set_link_shaping_override(0, factor=0.25)
+    # context exit clears every override, like the drop hook
+    assert protocol.get_link_shaping(0) == {}
+
+
+def test_shaping_extra_seconds_math():
+    protocol.set_link_shaping_override(2, factor=0.25, delay_ms=100)
+    # 100 ms fixed + a 4x slowdown of a 0.3 s transfer adds 0.9 s
+    assert protocol.shaping_extra_seconds(2, 0.3) == pytest.approx(1.0)
+    assert protocol.shaping_extra_seconds(0, 0.3) == 0.0
+    # components clear independently; empty entries vanish
+    protocol.set_link_shaping_override(2, factor=None)
+    assert protocol.get_link_shaping(2) == {"delay_ms": 100.0}
+    protocol.set_link_shaping_override(2, delay_ms=None)
+    assert protocol.get_link_shaping(2) == {}
+
+
+# --------------------------------------------------------------------------
+# LinkObservatory controller surface
+# --------------------------------------------------------------------------
+
+def _fed_observatory():
+    obs = LinkObservatory(stale_after_s=30.0)
+    for party, bps in (("party0", 8e6), ("party1", 1e6), ("party2", 4e6)):
+        for i in range(3):
+            obs.observe(party, "global", nbytes=bps, seconds=1.0,
+                        t=100.0 + i)
+    return obs
+
+
+def test_snapshot_min_confidence_filters_stale_links():
+    obs = _fed_observatory()
+    obs.observe("party9", "global", nbytes=1e6, seconds=1.0, t=10.0)
+    snap = obs.snapshot(now=103.0)
+    assert "party9->global" in snap
+    filtered = obs.snapshot(now=103.0, min_confidence=0.5)
+    assert "party9->global" not in filtered           # ~93 s stale
+    assert set(filtered) == {"party0->global", "party1->global",
+                             "party2->global"}
+
+
+def test_best_relay_order_widest_first_deterministic():
+    obs = _fed_observatory()
+    assert obs.best_relay_order(now=103.0) == ["party0", "party2", "party1"]
+    # ties break by name: feed a twin of party0's throughput
+    for i in range(3):
+        obs.observe("partyA", "global", nbytes=8e6, seconds=1.0,
+                    t=100.0 + i)
+    order = obs.best_relay_order(now=103.0)
+    assert order[:2] == ["party0", "partyA"]
+    # stale links drop out entirely under the confidence gate
+    assert obs.best_relay_order(now=400.0, min_confidence=0.5) == []
+
+
+# --------------------------------------------------------------------------
+# policies
+# --------------------------------------------------------------------------
+
+def _obs(step, links=None, **kw):
+    return ControlObservation(step=step, links=links or {}, **kw)
+
+
+def _link(party, bps, conf=1.0, peer="global"):
+    return {f"{party}->{peer}": {
+        "party": party, "peer": peer, "throughput_bps": bps,
+        "rtt_s": 0.05, "loss_rate": 0.0, "samples": 3, "failures": 0,
+        "bytes_total": bps, "age_s": 0.0, "confidence": conf,
+        "stale": conf < 0.5}}
+
+
+def test_ratio_policy_moves_toward_matched_point_bounded():
+    pol = RatioPolicy(0.25, bounds=(0.25 / 8, 0.25), cooldown=0,
+                      step_limit=2.0, deadband=0.1)
+    links = _link("party0", 1e6)
+    # matched = bw * compute / (2 * dense) = 1e6 * 0.05 / (2 * 1e6)
+    d = pol.decide(_obs(0, links, compute_s=0.05, dc_dense_bytes=1e6))
+    assert d is not None and d.kind == "ratio"
+    # bounded multiplicative step: 0.25 -> 0.125, not straight to 0.025
+    assert d.value == pytest.approx(0.125)
+    d2 = pol.decide(_obs(1, links, compute_s=0.05, dc_dense_bytes=1e6))
+    assert d2.value == pytest.approx(0.0625)
+    # clamps at the lo bound eventually
+    for s in range(2, 8):
+        d3 = pol.decide(_obs(s, links, compute_s=0.05, dc_dense_bytes=1e6))
+        if d3 is None:
+            break
+    assert pol.current >= 0.25 / 8
+
+
+def test_ratio_policy_ef_floor_blocks_lowering():
+    pol = RatioPolicy(0.25, cooldown=0, ef_unsafe=0.5)
+    links = _link("party0", 1e6)
+    kw = dict(compute_s=0.05, dc_dense_bytes=1e6,
+              ef_residual_norm=10.0, grad_norm=1.0)
+    assert pol.decide(_obs(0, links, **kw)) is None   # lowering vetoed
+    # raises stay allowed under the same EF state
+    pol.current = 0.03125
+    wide = _link("party0", 1e9)
+    d = pol.decide(_obs(1, wide, **kw))
+    assert d is not None and d.value > 0.03125
+
+
+def test_ratio_policy_hysteresis_no_oscillation_on_noisy_trace():
+    pol = RatioPolicy(0.25, cooldown=3, deadband=0.25)
+    rng = np.random.RandomState(7)
+    decisions = []
+    for step in range(60):
+        bw = 2.4e6 * (1.0 + 0.15 * rng.randn())  # noisy but stationary
+        d = pol.decide(_obs(step, _link("party0", bw),
+                            compute_s=0.05, dc_dense_bytes=1e6))
+        if d is not None:
+            decisions.append(d)
+    # a stationary noisy link must not thrash the knob: after the
+    # initial approach to the matched point, the knob may settle but
+    # never see-saw — at most ONE direction reversal across the run
+    values = [d.value for d in decisions]
+    assert len(decisions) <= 4
+    for a, b in zip(values, values[1:]):
+        assert abs(b - a) > 0.2 * a  # every move clears the deadband
+    dirs = [1 if b > a else -1 for a, b in zip(values, values[1:])]
+    reversals = sum(1 for a, b in zip(dirs, dirs[1:]) if a != b)
+    assert reversals <= 1
+
+
+def test_cooldown_bounds_actuation_rate():
+    pol = RatioPolicy(0.25, cooldown=5, deadband=0.0, step_limit=1.01)
+    links = _link("party0", 1e5)  # far-off target: wants to move every step
+    fired = [s for s in range(30)
+             if pol.decide(_obs(s, links, compute_s=0.05,
+                                dc_dense_bytes=1e6)) is not None]
+    assert len(fired) <= 6
+    assert all(b - a >= 5 for a, b in zip(fired, fired[1:]))
+
+
+def test_depth_policy_schmitt_trigger_and_confirmation():
+    pol = DepthPolicy(enter=0.4, exit=0.2, confirm=2, cooldown=0)
+    # one spike is not enough (confirm=2)
+    assert pol.decide(_obs(0, exposed_comms=0.6)) is None
+    d = pol.decide(_obs(1, exposed_comms=0.6))
+    assert d is not None and d.value == 1
+    # inside the band: no exit (0.3 > exit=0.2) — the hysteresis hold
+    assert pol.decide(_obs(2, exposed_comms=0.3)) is None
+    assert pol.decide(_obs(3, exposed_comms=0.3)) is None
+    # the gate signal is exposed + hidden: fully-hidden comms do NOT
+    # read as "wire went idle" (that self-oscillation is the bug the
+    # wan-fraction signal exists to prevent)
+    assert pol.decide(_obs(4, exposed_comms=0.0, hidden_comms=0.5)) is None
+    assert pol.decide(_obs(5, exposed_comms=0.0, hidden_comms=0.5)) is None
+    assert pol.current == 1
+    # genuine compute re-domination exits after confirmation
+    assert pol.decide(_obs(6, exposed_comms=0.05, hidden_comms=0.05)) is None
+    d = pol.decide(_obs(7, exposed_comms=0.05, hidden_comms=0.05))
+    assert d is not None and d.value == 0
+    with pytest.raises(ValueError):
+        DepthPolicy(enter=0.3, exit=0.3)
+    # a system configured at depth 1 seeds the policy there (else the
+    # exit transition could never fire); compute dominance exits 1->0
+    pol1 = DepthPolicy(enter=0.4, exit=0.2, confirm=1, cooldown=0,
+                       initial=1)
+    d = pol1.decide(_obs(0, exposed_comms=0.05, hidden_comms=0.05))
+    assert d is not None and d.value == 0 and d.prev == 1
+    with pytest.raises(ValueError):
+        DepthPolicy(initial=2)
+
+
+def test_relay_policy_margin_and_release():
+    pol = RelayPolicy(min_gain=2.0, cooldown=0, min_confidence=0.5)
+    assert pol.release == pytest.approx(1.75)  # Schmitt pair default
+    even = {**_link("party0", 4e6), **_link("party1", 3.9e6),
+            **_link("party2", 4.1e6)}
+    assert pol.decide(_obs(0, even)) is None  # sub-margin: stay direct
+    # inside the [release, min_gain) band: direct fan-in HOLDS (a
+    # comparator would form here on the next noise spike)
+    band = {**_link("party0", 7.6e6), **_link("party1", 4e6)}  # 1.9x
+    assert pol.decide(_obs(1, band)) is None
+    skewed = {**_link("party0", 8e6), **_link("party1", 1e6),
+              **_link("party2", 4e6)}
+    d = pol.decide(_obs(2, skewed))
+    assert d is not None and list(d.value) == ["party0", "party2", "party1"]
+    # asymmetry sagging into the band holds the formed overlay too —
+    # hovering around min_gain cannot thrash form/release/form
+    assert pol.decide(_obs(3, band)) is None
+    assert pol.current == ("party0", "party2", "party1")
+    # genuine recovery (below release) releases back to direct fan-in
+    d2 = pol.decide(_obs(4, even))
+    assert d2 is not None and d2.value == ()
+    # low-confidence links are invisible
+    lowconf = {**_link("party0", 8e6, conf=0.2),
+               **_link("party1", 1e6, conf=0.2)}
+    assert pol.decide(_obs(5, lowconf)) is None
+    with pytest.raises(ValueError):
+        RelayPolicy(min_gain=2.0, release=2.5)
+
+
+def test_pilot_tick_is_deterministic_and_interval_gated():
+    def run():
+        reg_obs = _fed_observatory()
+        sensors = ControlSensors(observatory=reg_obs,
+                                 registry=_FakeRegistry(),
+                                 compute_s_fn=lambda s: 0.05)
+        pilot = GraftPilot(
+            sensors,
+            ratio=RatioPolicy(0.25, cooldown=1),
+            depth=DepthPolicy(cooldown=1),
+            relay=RelayPolicy(min_gain=2.0, cooldown=1),
+            interval=2)
+        out = []
+        for step in range(10):
+            out.extend(d.to_json() for d in pilot.tick(step, now=103.0))
+        return out
+    a, b = run(), run()
+    assert a == b
+    assert all(d["step"] % 2 == 0 for d in a)  # interval gating
+
+
+class _FakeRegistry:
+    def get(self, name):
+        return None
+
+
+# --------------------------------------------------------------------------
+# sensors
+# --------------------------------------------------------------------------
+
+def test_sensors_fold_registry_links_and_liveness():
+    reg = reset_registry()
+    fam = reg.gauge("geomx_step_probe", "probe", ("probe",))
+    fam.labels(probe="ef_residual_norm").set(0.5)
+    fam.labels(probe="grad_norm_global").set(2.0)
+    fam.labels(probe="dc_dense_bytes").set(1e6)
+    ph = reg.gauge("geomx_phase_fraction", "phase", ("phase",))
+    ph.labels(phase="exposed_comms").set(0.3)
+    ph.labels(phase="hidden_comms").set(0.1)
+
+    class _Liveness:
+        class epoch:
+            version = 4
+            live_mask = (True, False, True)
+            num_live = 2
+
+    obs = ControlSensors(observatory=_fed_observatory(), registry=reg,
+                         liveness=_Liveness(),
+                         min_confidence=0.5).observe(7, now=103.0)
+    assert obs.step == 7
+    assert obs.ef_residual_norm == 0.5 and obs.grad_norm == 2.0
+    assert obs.dc_dense_bytes == 1e6
+    assert obs.exposed_comms == pytest.approx(0.3)
+    assert obs.hidden_comms == pytest.approx(0.1)
+    assert obs.roster_epoch == 4 and obs.num_live == 2
+    assert obs.live_mask == (True, False, True)
+    assert set(obs.links) == {"party0->global", "party1->global",
+                              "party2->global"}
+    reset_registry()
+
+
+# --------------------------------------------------------------------------
+# actuation (trainer-level)
+# --------------------------------------------------------------------------
+
+def _ctl_trainer(control=True, telemetry=True, depth=0, audit=False):
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    cfg = GeoConfig(num_parties=2, workers_per_party=1,
+                    compression="bsc,0.25,min_sparse_size=16",
+                    telemetry=telemetry, control=control,
+                    pipeline_depth=depth, audit=audit)
+    return Trainer(MLP(num_classes=10, hidden=(32,)), topo,
+                   optax.sgd(0.05), sync=get_sync_algorithm(cfg),
+                   config=cfg, donate=False)
+
+
+def _mini_batch():
+    rng = np.random.RandomState(0)
+    x = (rng.rand(2, 1, 4, 8, 8, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, size=(2, 1, 4)).astype(np.int32)
+    return x, y
+
+
+def _placed(tr, x, y):
+    sharding = tr.topology.batch_sharding(tr.mesh)
+    return jax.device_put(x, sharding), jax.device_put(y, sharding)
+
+
+def test_ratio_retune_changes_emitted_fraction_without_recompile():
+    x, y = _mini_batch()
+    tr = _ctl_trainer()
+    state = tr.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    xb, yb = _placed(tr, x, y)
+    # warm both jit cache entries (init-sharding + output-sharding keys)
+    for _ in range(2):
+        state, metrics = tr.train_step(state, xb, yb)
+    warm = tr.train_step._cache_size()
+    t = jax.device_get(metrics["telemetry"])
+    assert float(t["bsc_emitted_fraction"]) == 1.0
+    state = tr.apply_control(state, Decision(
+        step=2, kind="ratio", value=0.0625, prev=0.25, reason="test"))
+    state, metrics = tr.train_step(state, xb, yb)
+    t = jax.device_get(metrics["telemetry"])
+    # eff_k = round(k * 0.25): a quarter of the capacity slots emit
+    assert float(t["bsc_emitted_fraction"]) == pytest.approx(0.25, abs=0.02)
+    assert float(t["control_ratio_scale"]) == pytest.approx(0.25)
+    # THE no-recompile guarantee
+    assert tr.train_step._cache_size() == warm
+
+
+def test_control_disabled_jaxpr_is_byte_identical(monkeypatch):
+    """The telemetry-style hard guarantee: GEOMX_CONTROL=0 traces a
+    step byte-identical to a build where the control plumbing cannot
+    even run."""
+    monkeypatch.delenv("GEOMX_CONTROL", raising=False)
+    x, y = _mini_batch()
+    tr = _ctl_trainer(control=False, telemetry=False)
+    state = tr.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    xb, yb = _placed(tr, x, y)
+    j_off = canonicalize_jaxpr(
+        str(jax.make_jaxpr(tr.train_step)(state, xb, yb)))
+
+    def _poison(*a, **k):
+        raise AssertionError("control context opened on the disabled path")
+
+    monkeypatch.setattr(actuators_mod, "control_operands", _poison)
+    tr2 = _ctl_trainer(control=False, telemetry=False)
+    j_base = canonicalize_jaxpr(
+        str(jax.make_jaxpr(tr2.train_step)(state, xb, yb)))
+    assert j_off == j_base
+
+
+def test_control_operand_context_scoping():
+    import jax.numpy as jnp
+    assert current_ratio_scale() is None
+    with control_operands({"bsc_ratio_scale": jnp.float32(0.5)}):
+        assert float(current_ratio_scale()) == 0.5
+        with control_operands({"bsc_ratio_scale": jnp.float32(0.25)}):
+            assert float(current_ratio_scale()) == 0.25
+        assert float(current_ratio_scale()) == 0.5
+    assert current_ratio_scale() is None
+
+
+def test_depth_switch_recompile_boundary_carries_ef_state():
+    from geomx_tpu.sync.pipeline import PipelinedSync
+    x, y = _mini_batch()
+    tr = _ctl_trainer(audit=True)
+    state = tr.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    xb, yb = _placed(tr, x, y)
+    tr._audit_capture(state, xb, yb)
+    for _ in range(3):
+        state, _ = tr.train_step(state, xb, yb)
+    ef_before = jax.device_get(
+        jax.tree.leaves(state.sync_state["dc_comp"])[0])
+    assert float(np.abs(ef_before).sum()) > 0  # EF mass accumulated
+    state = tr.apply_control(state, Decision(
+        step=3, kind="depth", value=1, prev=0, reason="test"))
+    assert isinstance(tr.sync, PipelinedSync) and tr.control_depth() == 1
+    ef_after = jax.device_get(jax.tree.leaves(
+        state.sync_state["inner"]["dc_comp"]["inner"])[0])
+    np.testing.assert_array_equal(ef_before[0, 0], ef_after[0, 0])
+    # the pipelined program runs, control operands intact
+    state, metrics = tr.train_step(state, xb, yb)
+    assert CONTROL_KEY in state.sync_state
+    # switching back drains the in-flight aggregate first
+    state = tr.apply_control(state, Decision(
+        step=5, kind="depth", value=0, prev=1, reason="test"))
+    assert tr.control_depth() == 0
+    state, metrics = tr.train_step(state, xb, yb)
+    assert np.isfinite(float(metrics["loss"]))
+    # per-decision program cache: flipping again reuses the compiled fn
+    cached = tr._control_cache[(1, None)]
+    state = tr.apply_control(state, Decision(
+        step=7, kind="depth", value=1, prev=0, reason="test"))
+    assert tr.train_step is cached
+
+
+def test_apply_control_rejections():
+    x, y = _mini_batch()
+    tr = _ctl_trainer(control=False, telemetry=False)
+    with pytest.raises(ValueError, match="GEOMX_CONTROL"):
+        tr.apply_control(None, Decision(step=0, kind="ratio", value=0.1,
+                                        prev=0.2, reason="r"))
+    tr2 = _ctl_trainer()
+    x, y = _mini_batch()
+    state = tr2.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    with pytest.raises(ValueError, match="ratio | depth"):
+        tr2.apply_control(state, Decision(step=0, kind="relay", value=(),
+                                          prev=(), reason="r"))
+
+
+# --------------------------------------------------------------------------
+# surfaces: decision log, flight ring, scheduler HTTP
+# --------------------------------------------------------------------------
+
+def test_decision_log_bounded_and_isolated():
+    log = DecisionLog(capacity=3)
+    for i in range(5):
+        log.append({"step": i, "kind": "ratio", "value": i})
+    snap = log.snapshot()
+    assert [e["step"] for e in snap] == [2, 3, 4]
+    assert log.total == 5
+    fresh = reset_decision_log()
+    assert fresh.snapshot() == []
+
+
+def test_actuator_records_to_log_flight_and_registry():
+    reset_registry()
+    log = DecisionLog()
+    flight = FlightRecorder(capacity=8, dump_dir="")
+    act = ControlActuator(trainer=None, relay_apply=lambda order: None,
+                          flight=flight, log=log)
+    act.apply(None, Decision(step=4, kind="relay",
+                             value=("party1", "party0"), prev=(),
+                             reason="test"))
+    assert log.snapshot()[0]["kind"] == "relay"
+    assert flight.decisions()[0]["value"] == ["party1", "party0"]
+    with pytest.raises(ValueError, match="unknown decision kind"):
+        act.apply(None, Decision(step=5, kind="bogus", value=1, prev=0,
+                                 reason="r"))
+    with pytest.raises(ValueError, match="trainer-bound"):
+        act.apply(None, Decision(step=6, kind="ratio", value=0.1,
+                                 prev=0.2, reason="r"))
+    reset_registry()
+
+
+def test_flight_bundle_includes_decisions(tmp_path):
+    flight = FlightRecorder(capacity=8, dump_dir=str(tmp_path),
+                            min_history=1)
+    flight.record_decision({"step": 1, "kind": "ratio", "value": 0.05})
+    flight.record_decision({"step": 2, "kind": "relay", "value": []})
+    for step in range(3):
+        flight.record(step, {"grad_norm_global": 1.0})
+    fired = flight.record(3, {"grad_norm_global": float("nan")})
+    assert fired and flight.dumps
+    bundle = json.loads(open(flight.dumps[0]).read())
+    assert [d["step"] for d in bundle["decisions"]] == [1, 2]
+
+
+def test_scheduler_serves_control_decision_history():
+    from geomx_tpu.service.scheduler import GeoScheduler
+    log = reset_decision_log()
+    log.append({"step": 3, "kind": "depth", "value": 1, "prev": 0,
+                "reason": "wan_fraction 0.5 > enter 0.25"})
+    sched = GeoScheduler(port=0, metrics_port=0).start()
+    try:
+        url = f"http://127.0.0.1:{sched.metrics_port}/control"
+        body = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        assert body["total"] == 1
+        assert body["decisions"][0]["kind"] == "depth"
+        assert body["capacity"] == log.capacity
+    finally:
+        sched.stop()
+        reset_decision_log()

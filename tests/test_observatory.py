@@ -750,6 +750,32 @@ def test_benchtrend_multichip_ok_flip_is_a_regression(tmp_path):
     assert {v["metric"] for v in report["regressions"]} == {"ok", "rc_ok"}
 
 
+def test_benchtrend_control_series_gated(tmp_path):
+    bt = _bt()
+
+    def _write_control(name, beats, ttt):
+        (tmp_path / name).write_text(json.dumps({
+            "mode": "compare_control",
+            "controller_beats_all_static": beats,
+            "decision_log_deterministic": True,
+            "ratio_retune_without_recompile": True,
+            "controller": {"time_to_target_s": ttt}}))
+
+    _write_control("CONTROL_r01.json", True, 2.0)
+    _write_control("CONTROL_r02.json", True, 2.1)     # +5% in band
+    report = bt.run(str(tmp_path), band=0.10)
+    assert report["passed"]
+    assert {v["metric"] for v in report["verdicts"]["CONTROL"]} == {
+        "controller_beats_all_static", "decision_log_deterministic",
+        "ratio_retune_without_recompile", "controller.time_to_target_s"}
+    # a gate flip AND a time-to-target blowup both regress
+    _write_control("CONTROL_r03.json", False, 5.0)
+    report = bt.run(str(tmp_path), band=0.10)
+    assert not report["passed"]
+    assert {v["metric"] for v in report["regressions"]} == {
+        "controller_beats_all_static", "controller.time_to_target_s"}
+
+
 def test_benchtrend_missing_metric_reported_not_fatal(tmp_path):
     bt = _bt()
     _write_capture(tmp_path, "BENCH_CAPTURED_r01.json", 1000.0, 0.17, 13.0)

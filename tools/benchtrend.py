@@ -3,7 +3,10 @@
 
 The repo carries its own measurement history — ``BENCH_r*.json``
 (driver-wrapped runs), ``BENCH_CAPTURED_r*.json`` (real hardware
-captures) and ``MULTICHIP_r*.json`` (the 8-device dryrun matrix).
+captures), ``MULTICHIP_r*.json`` (the 8-device dryrun matrix) and
+``CONTROL_r*.json`` (the ``--compare-control`` chaos-replay
+acceptance: its three boolean gates plus the controller's
+time-to-loss-target, lower is better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
 until a human re-read the numbers.  This tool makes the trajectory a
@@ -51,6 +54,7 @@ DIRECTION = {
     "mfu": "up",
     "samples_per_sec": "up",
     "step_time_ms": "down",
+    "time_to_target_s": "down",
     "vs_baseline": "up",
 }
 
@@ -92,6 +96,18 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
         out["rc_ok"] = (doc.get("rc") == 0)
         if not doc.get("skipped"):
             out["n_devices"] = doc.get("n_devices")
+        return out
+    if rec.get("mode") == "compare_control":  # CONTROL_r*
+        for gate in ("controller_beats_all_static",
+                     "decision_log_deterministic",
+                     "ratio_retune_without_recompile"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        ctl = rec.get("controller")
+        if isinstance(ctl, dict) and isinstance(
+                ctl.get("time_to_target_s"), (int, float)):
+            out["controller.time_to_target_s"] = float(
+                ctl["time_to_target_s"])
         return out
 
     dev = rec.get("device") or {}
@@ -178,7 +194,7 @@ def compare_series(runs: List[Tuple[str, Dict[str, Any]]],
 def run(repo_dir: str, band: float = DEFAULT_BAND,
         patterns: Optional[List[str]] = None) -> dict:
     patterns = patterns or ["BENCH_CAPTURED_r*.json", "BENCH_r*.json",
-                            "MULTICHIP_r*.json"]
+                            "MULTICHIP_r*.json", "CONTROL_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     unreadable: List[str] = []
     for pat in patterns:
