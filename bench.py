@@ -66,6 +66,21 @@ Micro-modes:
       re-admission catch-up payload is measured, and the party count /
       WAN wire-volume accounting return to pre-failure values.  CPU, no
       TPU needed (docs/resilience.md).
+  bench.py --compare-recovery [--steps=12] [--parties=2] [--dim=256]
+           [--schedule="seed=7;kill@4:node=server,restart_after=2;..."]
+           [--corrupt-schedule="seed=7;corrupt@1:party=0,rate=35,steps=8"]
+      One JSON line for the durable host plane (docs/resilience.md
+      "Host-plane recovery"): a seeded host-plane training run whose
+      chaos schedule kills and restarts the global GeoPSServer AND the
+      GeoScheduler mid-run finishes with params BIT-EXACT vs an
+      uninterrupted same-seed baseline (atomic-snapshot + journal
+      store, generation-token session resume) within a bounded stall;
+      scheduler ids stay stable across its restart with no grace-window
+      mass eviction; a seeded corrupt@ bit-flip replay yields zero
+      process crashes, nonzero geomx_wire_crc_errors_total and
+      unchanged final params; a hostile frame-length prefix is
+      rejected at GEOMX_MAX_FRAME_BYTES.  Pure service plane (sockets
+      + numpy) — no jax mesh, CPU, seconds.
   bench.py --audit [--model=mlp]
       One JSON line for the Graft Auditor (geomx_tpu/analysis/,
       docs/analysis.md): every green tier-1 step program (vanilla, bsc,
@@ -3404,6 +3419,433 @@ def parent_main():
     print_snapshot(error=error, partial=False)
 
 
+# --------------------------------------------------------------------------
+# --compare-recovery: kill/restart the global server AND the scheduler
+# mid-training; finish bit-exact vs an uninterrupted same-seed baseline
+# --------------------------------------------------------------------------
+
+
+class _RecoveryCluster:
+    """One host-plane training cluster: scheduler + global GeoPSServer
+    (durable) + per-party local servers relaying up + one worker client
+    per party (session-resume armed).  The chaos ``kill@`` verbs drive
+    :meth:`lifecycle`: kill = ``crash()`` (abrupt socket severing, only
+    the durable store survives), restart = a replacement process image
+    on the same durable dir and port."""
+
+    def __init__(self, base_dir: str, parties: int, keys, dim: int,
+                 grace_s: float = 30.0):
+        import numpy as np
+
+        from geomx_tpu.service import (GeoPSClient, GeoPSServer,
+                                       GeoScheduler, SchedulerClient)
+        self.np = np
+        self.parties = parties
+        self.keys = list(keys)
+        self.dim = dim
+        self.base_dir = base_dir
+        self.grace_s = grace_s
+        self._GeoPSServer = GeoPSServer
+        self._GeoScheduler = GeoScheduler
+        self.sched_dir = os.path.join(base_dir, "scheduler")
+        self.global_dir = os.path.join(base_dir, "global")
+        self.scheduler = GeoScheduler(durable_dir=self.sched_dir,
+                                      restart_grace_s=grace_s).start()
+        self.sched_port = self.scheduler.port
+        self.glob = GeoPSServer(num_workers=parties, mode="sync",
+                                accumulate=True, rank=0,
+                                durable_dir=self.global_dir,
+                                durable_name="global").start()
+        self.glob_port = self.glob.port
+        self.locals = [
+            GeoPSServer(num_workers=1, mode="sync", rank=1 + p,
+                        global_addr=("127.0.0.1", self.glob_port),
+                        global_sender_id=1000 + p,
+                        reconnect=True).start()
+            for p in range(parties)]
+        self.workers = [
+            GeoPSClient(("127.0.0.1", self.locals[p].port), sender_id=p,
+                        reconnect=True)
+            for p in range(parties)]
+        # every party registers with the scheduler under a stable tag —
+        # the id-stability-across-restart probe re-registers these
+        self.sched_clients = [SchedulerClient(("127.0.0.1",
+                                               self.sched_port))
+                              for _ in range(parties)]
+        self.node_ids = {}
+        for p, sc in enumerate(self.sched_clients):
+            sc.register("worker", tag=f"{p}.0")
+            sc.start_heartbeat(interval_s=1.0)
+            self.node_ids[p] = sc.node_id
+        for p, w in enumerate(self.workers):
+            for key in self.keys:
+                w.init(key, np.zeros(dim, np.float32))
+        self.restarts = {"server": 0, "scheduler": 0}
+        self.kill_t = {}
+        self.outage_s = 0.0
+        self.killed = set()
+        self.post_restart = {"ids_stable": None, "mass_evicted": None,
+                             "is_recovery": None, "in_grace": None}
+
+    def lifecycle(self, action: str, node: str) -> None:
+        now = time.monotonic()
+        if node == "server":
+            if action == "kill":
+                self.kill_t[node] = now
+                self.glob.crash()
+                self.killed.add(node)
+            else:
+                self.glob = self._GeoPSServer(
+                    num_workers=self.parties, mode="sync",
+                    accumulate=True, rank=0, port=self.glob_port,
+                    durable_dir=self.global_dir,
+                    durable_name="global").start()
+                self.restarts[node] += 1
+                self.killed.discard(node)
+                self.outage_s += now - self.kill_t.pop(node, now)
+        elif node == "scheduler":
+            if action == "kill":
+                self.kill_t[node] = now
+                self.scheduler.crash()
+                self.killed.add(node)
+            else:
+                self.scheduler = self._GeoScheduler(
+                    port=self.sched_port, durable_dir=self.sched_dir,
+                    restart_grace_s=self.grace_s).start()
+                self.restarts[node] += 1
+                self.killed.discard(node)
+                self.outage_s += now - self.kill_t.pop(node, now)
+                self._probe_scheduler_recovery()
+
+    def _probe_scheduler_recovery(self) -> None:
+        """Right after a scheduler restart: every party re-registers
+        under its original (role, tag) and must get its OLD id back
+        (is_recovery), and the grace window must hold the dead list
+        shut — a restart is not a mass party death."""
+        from geomx_tpu.service import SchedulerClient
+        probe = SchedulerClient(("127.0.0.1", self.sched_port))
+        try:
+            ids_ok, recovery_ok = True, True
+            for p in range(self.parties):
+                meta = probe.register("worker", tag=f"{p}.0")
+                ids_ok &= probe.node_id == self.node_ids[p]
+                recovery_ok &= bool(meta["is_recovery"])
+            dead = probe.dead_nodes()
+            self.post_restart = {
+                "ids_stable": ids_ok,
+                "is_recovery": recovery_ok,
+                "mass_evicted": len(dead) > 0,
+                "in_grace": self.scheduler.in_restart_grace()}
+        finally:
+            probe.close()
+
+    def close(self, stop_tiers: bool = True) -> None:
+        if stop_tiers:
+            for w in self.workers:
+                try:
+                    w.stop_server()
+                except Exception:
+                    pass
+        for w in self.workers:
+            w.close()
+        for sc in self.sched_clients:
+            try:
+                sc.close()
+            except Exception:
+                pass
+        for srv in self.locals:
+            try:
+                srv.stop(forward=False)
+            except Exception:
+                pass
+        try:
+            self.glob.stop(forward=False)
+        except Exception:
+            pass
+        try:
+            self.scheduler.stop()
+        except Exception:
+            pass
+
+
+def _recovery_train(base_dir: str, steps: int, parties: int, keys,
+                    dim: int, schedule=None, seed: int = 777,
+                    stall_dwell_s: float = 0.4):
+    """One seeded host-plane training run; returns final params (per
+    key, from worker 0), per-step losses, wall time and restart stats.
+    With a chaos ``schedule``, the driver replays it on a logical step
+    clock that keeps ticking while an outage stalls worker progress —
+    so ``restart_after=N`` fires even when the killed node is the very
+    thing progress is waiting on."""
+    import numpy as np
+
+    from geomx_tpu.resilience.chaos import (ChaosEngine,
+                                            set_node_lifecycle_hook)
+    cluster = _RecoveryCluster(base_dir, parties, keys, dim)
+    targets = {p: {key: np.full(dim, (p + 1) * (k_i + 1), np.float32)
+                   for k_i, key in enumerate(keys)}
+               for p in range(parties)}
+    progress = [0] * parties
+    errors = []
+    losses = [[] for _ in range(parties)]
+    # LOCK-STEP chaos clock: workers may not START step s until the
+    # driver has ticked the schedule at s, so kill@s always lands
+    # before any step-s traffic — machine speed can neither batch
+    # kill+restart into a zero-length outage nor let the run finish
+    # before the first kill ever fires
+    cond = threading.Condition()
+    allowed = [0]
+
+    def worker_loop(p):
+        rng = np.random.default_rng(seed + p)
+        w = cluster.workers[p]
+        try:
+            for step in range(steps):
+                with cond:
+                    while step >= allowed[0]:
+                        cond.wait(0.5)
+                step_loss = 0.0
+                for key in keys:
+                    val = w.pull(key, timeout=120.0)
+                    g = (val - targets[p][key]) * 0.1 \
+                        + rng.normal(0.0, 0.01, dim).astype(np.float32)
+                    w.push(key, (-0.05 * g).astype(np.float32))
+                    step_loss += float(np.mean(
+                        (val - targets[p][key]) ** 2))
+                losses[p].append(step_loss / len(keys))
+                progress[p] = step + 1
+        except Exception as e:  # surfaced in the record, fails the gate
+            errors.append(f"party {p}: {e!r}")
+
+    threads = [threading.Thread(target=worker_loop, args=(p,),
+                                daemon=True) for p in range(parties)]
+    t0 = time.monotonic()
+    engine = None
+    if schedule is not None:
+        engine = ChaosEngine(schedule, controller=None)
+        set_node_lifecycle_hook(cluster.lifecycle)
+    try:
+        for t in threads:
+            t.start()
+        for s in range(steps):
+            if engine is not None:
+                engine.tick(s)
+            with cond:
+                allowed[0] = s + 1
+                cond.notify_all()
+            # wait for every worker to finish step s before the next
+            # tick; during an outage progress stalls on the killed
+            # node, so a dwell escape keeps the logical clock moving —
+            # that is what delivers the paired restart@ event
+            stall_t = time.monotonic()
+            last = min(progress)
+            while min(progress) <= s:
+                if errors or not any(t.is_alive() for t in threads):
+                    break
+                if min(progress) > last:
+                    last, stall_t = min(progress), time.monotonic()
+                if cluster.killed and \
+                        time.monotonic() - stall_t > stall_dwell_s:
+                    break  # outage: advance the clock toward restart@
+                time.sleep(0.02)
+            if errors:
+                break
+        with cond:
+            allowed[0] = steps  # release anyone still gated
+            cond.notify_all()
+        for t in threads:
+            t.join(timeout=300.0)
+        wall_s = time.monotonic() - t0
+        final = {key: np.asarray(cluster.workers[0].pull(key,
+                                                         timeout=60.0))
+                 for key in keys} if not errors else {}
+        return {"final": final, "losses": losses, "wall_s": wall_s,
+                "errors": errors, "restarts": dict(cluster.restarts),
+                "outage_s": cluster.outage_s,
+                "post_restart": dict(cluster.post_restart),
+                "journal": {
+                    "records": (cluster.glob._durable.records_appended
+                                if cluster.glob._durable else 0),
+                    "journal_bytes": (cluster.glob._durable.journal_bytes()
+                                      if cluster.glob._durable else 0),
+                    "generation": cluster.glob.generation}}
+    finally:
+        if engine is not None:
+            engine.close()
+            set_node_lifecycle_hook(None)
+        cluster.close(stop_tiers=not errors)
+
+
+def _frame_cap_probe() -> dict:
+    """Craft a frame whose 4-byte length prefix announces more than
+    GEOMX_MAX_FRAME_BYTES: the server must close the connection (no
+    allocation, no crash) and keep serving its other clients."""
+    import socket as _socket
+    import struct as _struct
+
+    import numpy as np
+
+    from geomx_tpu.service import GeoPSClient, GeoPSServer
+    from geomx_tpu.service.protocol import max_frame_bytes
+    srv = GeoPSServer(num_workers=1, mode="sync", accumulate=True).start()
+    try:
+        c = GeoPSClient(("127.0.0.1", srv.port), sender_id=0)
+        c.init("w", np.zeros(8, np.float32))
+        evil = _socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5.0)
+        evil.settimeout(5.0)
+        announced = max_frame_bytes() + 1
+        evil.sendall(_struct.pack("<I", announced & 0xFFFFFFFF))
+        try:
+            closed = evil.recv(1) == b""
+        except OSError:
+            closed = True
+        evil.close()
+        # the tier survived: the well-behaved client still round-trips
+        c.push("w", np.ones(8, np.float32))
+        alive = bool(np.allclose(c.pull("w"), 1.0))
+        c.stop_server()
+        c.close()
+        return {"announced_bytes": int(announced),
+                "connection_closed": bool(closed),
+                "server_survived": alive,
+                "enforced": bool(closed and alive)}
+    finally:
+        srv.join(5)
+
+
+def _compare_recovery(steps: int = 12, parties: int = 2, dim: int = 256,
+                      schedule_spec: str = None,
+                      corrupt_spec: str = None, seed: int = 777):
+    """The host-plane recovery acceptance (docs/resilience.md):
+
+    1. BASELINE — an uninterrupted seeded run; final params recorded.
+    2. RECOVERY — the same seeds with a chaos schedule that kills and
+       restarts the global server AND the scheduler mid-training
+       (``kill@...restart_after=...``): must finish with params
+       BIT-EXACT vs baseline, a bounded stall, stable scheduler ids and
+       no grace-window mass eviction.
+    3. CORRUPT — the same seeds under a seeded ``corrupt@`` bit-flip
+       epoch: zero process crashes, a nonzero
+       ``geomx_wire_crc_errors_total``, params again bit-exact (the
+       wire-CRC gate turns corruption into retries, not divergence).
+    4. FRAME CAP — a hostile length prefix is rejected without an
+       allocation and without taking the tier down.
+    """
+    import numpy as np
+
+    from geomx_tpu.resilience.chaos import ChaosSchedule
+    from geomx_tpu.service.protocol import wire_crc_errors
+    if schedule_spec is None:
+        schedule_spec = (f"seed={seed};"
+                         "kill@4:node=server,restart_after=2;"
+                         "kill@8:node=scheduler,restart_after=1")
+    if corrupt_spec is None:
+        corrupt_spec = f"seed={seed};corrupt@1:party=0,rate=35,steps=8"
+    schedule = ChaosSchedule.from_spec(schedule_spec)
+    corrupt_schedule = ChaosSchedule.from_spec(corrupt_spec)
+    keys = ["w0", "w1"]
+    rec = {"mode": "compare_recovery", "steps": steps,
+           "parties": parties, "dim": dim, "keys": keys,
+           "schedule": schedule.spec(),
+           "corrupt_schedule": corrupt_schedule.spec()}
+
+    with tempfile.TemporaryDirectory(prefix="geomx_recovery_") as td:
+        base = _recovery_train(os.path.join(td, "baseline"), steps,
+                               parties, keys, dim, schedule=None,
+                               seed=seed)
+        reco = _recovery_train(os.path.join(td, "recovery"), steps,
+                               parties, keys, dim, schedule=schedule,
+                               seed=seed)
+        crc_before = wire_crc_errors()
+        corr = _recovery_train(os.path.join(td, "corrupt"), steps,
+                               parties, keys, dim,
+                               schedule=corrupt_schedule, seed=seed)
+        crc_errors = wire_crc_errors() - crc_before
+
+    def digest(final):
+        import hashlib
+        h = hashlib.sha256()
+        for key in keys:
+            h.update(np.ascontiguousarray(final[key]).tobytes())
+        return h.hexdigest()
+
+    def bit_exact(a, b):
+        return bool(a and b and all(
+            np.array_equal(a[key], b[key]) for key in keys))
+
+    stall_s = max(0.0, reco["wall_s"] - base["wall_s"])
+    rec["baseline"] = {"wall_s": round(base["wall_s"], 3),
+                       "errors": base["errors"],
+                       "loss_final": base["losses"][0][-1]
+                       if base["losses"][0] else None,
+                       "params_digest": digest(base["final"])
+                       if base["final"] else None}
+    rec["recovery"] = {"wall_s": round(reco["wall_s"], 3),
+                       "errors": reco["errors"],
+                       "restarts": reco["restarts"],
+                       "outage_s": round(reco["outage_s"], 3),
+                       "post_restart": reco["post_restart"],
+                       "journal": reco["journal"],
+                       "params_digest": digest(reco["final"])
+                       if reco["final"] else None}
+    rec["corrupt"] = {"wall_s": round(corr["wall_s"], 3),
+                      "errors": corr["errors"],
+                      "crc_errors": crc_errors,
+                      "loss_final": corr["losses"][0][-1]
+                      if corr["losses"][0] else None,
+                      "params_digest": digest(corr["final"])
+                      if corr["final"] else None}
+    rec["frame_cap"] = _frame_cap_probe()
+
+    # ---- the acceptance gates (benchtrend + recovery-smoke CI) -------
+    rec["params_bit_exact"] = bit_exact(base["final"], reco["final"])
+    rec["server_restarted"] = reco["restarts"]["server"] >= 1
+    rec["scheduler_restarted"] = reco["restarts"]["scheduler"] >= 1
+    rec["recovery_stall_s"] = round(stall_s, 3)
+    # bounded: the stall may not exceed the injected outage plus a
+    # fixed resume allowance (reconnect backoff + resend timers)
+    rec["recovery_stall_bounded"] = bool(
+        stall_s <= reco["outage_s"] + 15.0)
+    rec["scheduler_ids_stable"] = bool(
+        reco["post_restart"].get("ids_stable")
+        and reco["post_restart"].get("is_recovery"))
+    rec["scheduler_no_mass_evict"] = \
+        reco["post_restart"].get("mass_evicted") is False
+    rec["corrupt_zero_crashes"] = not corr["errors"]
+    rec["corrupt_crc_nonzero"] = crc_errors > 0
+    rec["corrupt_loss_unchanged"] = bit_exact(base["final"],
+                                              corr["final"])
+    rec["frame_cap_enforced"] = rec["frame_cap"]["enforced"]
+    rec["ok"] = bool(
+        not base["errors"] and not reco["errors"]
+        and rec["params_bit_exact"] and rec["server_restarted"]
+        and rec["scheduler_restarted"] and rec["recovery_stall_bounded"]
+        and rec["scheduler_ids_stable"]
+        and rec["scheduler_no_mass_evict"]
+        and rec["corrupt_zero_crashes"] and rec["corrupt_crc_nonzero"]
+        and rec["corrupt_loss_unchanged"] and rec["frame_cap_enforced"])
+    return rec
+
+
+def compare_recovery_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--steps="):
+            kwargs["steps"] = int(a.split("=", 1)[1])
+        elif a.startswith("--parties="):
+            kwargs["parties"] = int(a.split("=", 1)[1])
+        elif a.startswith("--dim="):
+            kwargs["dim"] = int(a.split("=", 1)[1])
+        elif a.startswith("--schedule="):
+            kwargs["schedule_spec"] = a.split("=", 1)[1]
+        elif a.startswith("--corrupt-schedule="):
+            kwargs["corrupt_spec"] = a.split("=", 1)[1]
+        elif a.startswith("--seed="):
+            kwargs["seed"] = int(a.split("=", 1)[1])
+    _emit(_compare_recovery(**kwargs))
+
+
 def main():
     if "--compare-kernels" in sys.argv:
         # kernel micro-mode: in-process, single device is enough (no
@@ -3455,6 +3897,10 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=3").strip()
         compare_control_main(sys.argv[1:])
+    elif "--compare-recovery" in sys.argv:
+        # host-plane recovery acceptance: pure service-plane (sockets +
+        # numpy), no jax mesh — runs anywhere in seconds
+        compare_recovery_main(sys.argv[1:])
     elif "--compare-resilience" in sys.argv:
         # chaos/structure micro-mode like --compare-pipeline: in-process
         # on the CPU backend with a 2-device virtual mesh

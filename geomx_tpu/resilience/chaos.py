@@ -36,6 +36,22 @@ Spec format (semicolon-separated events; see docs/resilience.md):
                                             link-quality shaping: m ms
                                             of added latency per WAN
                                             round on party p's link
+    kill@<step>:node=server|scheduler[,restart_after=<n>]
+                                            host-plane process death:
+                                            drives the installed node
+                                            lifecycle hook; with
+                                            restart_after, the paired
+                                            restart@ fires n steps later
+    restart@<step>:node=server|scheduler    explicit restart
+    corrupt@<step>:party=<p>,rate=<r>[,steps=<n>]
+                                            bit-corruption epoch: r% of
+                                            party p's retry-protected
+                                            data frames have one bit
+                                            flipped at send time (the
+                                            wire-CRC gate detects, the
+                                            retry path re-delivers);
+                                            party=-1 matches every
+                                            sender
 
 Example: ``"seed=7;blackout@3:party=1,steps=4;drop@10:rate=30,steps=5"``.
 
@@ -64,17 +80,36 @@ from typing import Iterable, List, Optional, Tuple
 # delay WITH a ``steps=`` window expands into its paired restore event
 # at build time, so the engine itself is a stateless replayer)
 _KINDS = ("blackout", "readmit", "drop_rate", "drop_clear",
-          "throttle", "throttle_clear", "delay", "delay_clear")
+          "throttle", "throttle_clear", "delay", "delay_clear",
+          "kill", "restart", "corrupt", "corrupt_clear")
+
+# kill/restart targets: the host plane's two central singletons
+_NODES = ("server", "scheduler")
+
+# host-plane lifecycle hook (``kill@``/``restart@``): the in-process
+# counterpart of protocol.set_drop_rate_override — whoever owns the
+# processes (the recovery bench, a test harness, a supervisor) installs
+# a callable ``hook(action, node)`` with action in ("kill", "restart")
+# and node in _NODES, and the engine drives it on schedule.
+_lifecycle_hook = None
+
+
+def set_node_lifecycle_hook(hook) -> None:
+    """Install (or clear, with None) the process-lifecycle hook the
+    ``kill@``/``restart@`` chaos verbs drive."""
+    global _lifecycle_hook
+    _lifecycle_hook = hook
 
 
 @dataclasses.dataclass(frozen=True, order=True)
 class ChaosEvent:
     step: int
     kind: str          # one of _KINDS
-    party: int = -1    # blackout/readmit/throttle/delay
-    rate: int = 0      # drop_rate, percent 0-100
+    party: int = -1    # blackout/readmit/throttle/delay/corrupt
+    rate: int = 0      # drop_rate / corrupt, percent 0-100
     factor: float = 0.0  # throttle: throughput multiplier (0 < f <= 1)
     ms: int = 0        # delay: added latency per WAN round
+    node: str = ""     # kill/restart: "server" | "scheduler"
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -82,6 +117,10 @@ class ChaosEvent:
                              f"valid: {_KINDS}")
         if self.step < 0:
             raise ValueError(f"chaos event step must be >= 0 ({self.step})")
+        if self.kind in ("kill", "restart") and self.node not in _NODES:
+            raise ValueError(
+                f"chaos {self.kind} targets node= one of {_NODES} "
+                f"(got {self.node!r})")
 
 
 class ChaosSchedule:
@@ -117,8 +156,15 @@ class ChaosSchedule:
                 parts.append(f"throttleclear@{e.step}:party={e.party}")
             elif e.kind == "delay":
                 parts.append(f"delay@{e.step}:party={e.party},ms={e.ms}")
-            else:  # delay_clear
+            elif e.kind == "delay_clear":
                 parts.append(f"delayclear@{e.step}:party={e.party}")
+            elif e.kind in ("kill", "restart"):
+                parts.append(f"{e.kind}@{e.step}:node={e.node}")
+            elif e.kind == "corrupt":
+                parts.append(
+                    f"corrupt@{e.step}:party={e.party},rate={e.rate}")
+            else:  # corrupt_clear
+                parts.append(f"corruptclear@{e.step}:party={e.party}")
         return ";".join(parts)
 
     # ---- constructors ------------------------------------------------------
@@ -150,9 +196,13 @@ class ChaosSchedule:
                 k, _, v = item.partition("=")
                 if not _:
                     raise ValueError(f"bad chaos option {item!r} in {raw!r}")
-                # every option is an integer except the throttle factor,
-                # which is a throughput multiplier in (0, 1]
-                kv[k] = float(v) if k == "factor" else int(v)
+                # every option is an integer except the throttle factor
+                # (a throughput multiplier in (0, 1]) and the kill/
+                # restart target node (a role name)
+                if k == "node":
+                    kv[k] = v
+                else:
+                    kv[k] = float(v) if k == "factor" else int(v)
             known = {"blackout": {"party", "steps"},
                      "flap": {"party", "steps"},
                      "readmit": {"party"},
@@ -161,7 +211,11 @@ class ChaosSchedule:
                      "throttle": {"party", "factor", "steps"},
                      "throttleclear": {"party"},
                      "delay": {"party", "ms", "steps"},
-                     "delayclear": {"party"}}
+                     "delayclear": {"party"},
+                     "kill": {"node", "restart_after"},
+                     "restart": {"node"},
+                     "corrupt": {"party", "rate", "steps"},
+                     "corruptclear": {"party"}}
             if kind not in known:
                 raise ValueError(f"unknown chaos kind {kind!r}; valid: "
                                  f"{sorted(known)}")
@@ -214,6 +268,30 @@ class ChaosSchedule:
             elif kind == "delayclear":
                 events.append(ChaosEvent(step, "delay_clear",
                                          party=kv["party"]))
+            elif kind in ("kill", "restart"):
+                events.append(ChaosEvent(step, kind,
+                                         node=str(kv["node"])))
+                # kill@S:node=X,restart_after=N expands into its paired
+                # restart, like every other duration-bearing verb
+                if kind == "kill" and kv.get("restart_after"):
+                    events.append(ChaosEvent(
+                        int(step + kv["restart_after"]), "restart",
+                        node=str(kv["node"])))
+            elif kind == "corrupt":
+                rate = kv["rate"]
+                if not 0 <= rate <= 100:
+                    raise ValueError(
+                        f"corrupt rate {rate} not in [0, 100]")
+                events.append(ChaosEvent(step, "corrupt",
+                                         party=kv.get("party", -1),
+                                         rate=rate))
+                if kv.get("steps"):
+                    events.append(ChaosEvent(int(step + kv["steps"]),
+                                             "corrupt_clear",
+                                             party=kv.get("party", -1)))
+            elif kind == "corruptclear":
+                events.append(ChaosEvent(step, "corrupt_clear",
+                                         party=kv.get("party", -1)))
             else:  # dropclear
                 events.append(ChaosEvent(step, "drop_clear"))
         return cls(events, seed=seed)
@@ -262,10 +340,13 @@ class ChaosEngine:
         self.drive_drop_hook = drive_drop_hook
         self._applied_through = -1
         if drive_drop_hook:
-            # reproducibility: the message-loss pattern inside a drop
-            # epoch derives from the schedule seed, not process history
-            from geomx_tpu.service.protocol import reseed_drop_rng
+            # reproducibility: the message-loss AND bit-corruption
+            # patterns inside their epochs derive from the schedule
+            # seed, not process history
+            from geomx_tpu.service.protocol import (reseed_corrupt_rng,
+                                                    reseed_drop_rng)
             reseed_drop_rng(schedule.seed)
+            reseed_corrupt_rng(schedule.seed)
 
     def tick(self, step: int) -> List[ChaosEvent]:
         """Apply every event scheduled in ``(last_tick, step]`` (skipped
@@ -290,11 +371,25 @@ class ChaosEngine:
                 self.controller.mark_dead(e.party)
             else:
                 self.controller.mark_live(e.party)
+        elif e.kind in ("kill", "restart"):
+            # host-plane process lifecycle: driven through the installed
+            # hook, never directly — the engine knows WHEN, the owner of
+            # the processes knows HOW (crash semantics, durable dirs,
+            # ports).  bench.py --compare-recovery is the reference user.
+            if _lifecycle_hook is None:
+                raise ValueError(
+                    f"chaos event {e} needs a node lifecycle hook "
+                    "(set_node_lifecycle_hook)")
+            _lifecycle_hook(e.kind, e.node)
         elif not self.drive_drop_hook:
             return
         elif e.kind in ("drop_rate", "drop_clear"):
             from geomx_tpu.service.protocol import set_drop_rate_override
             set_drop_rate_override(e.rate if e.kind == "drop_rate" else None)
+        elif e.kind in ("corrupt", "corrupt_clear"):
+            from geomx_tpu.service.protocol import set_corruption_override
+            set_corruption_override(
+                e.party, e.rate if e.kind == "corrupt" else None)
         else:
             # link-quality shaping: same in-process hook pattern as the
             # drop override — the transports consult it, the engine
@@ -315,9 +410,11 @@ class ChaosEngine:
         link degradation into the next."""
         if self.drive_drop_hook:
             from geomx_tpu.service.protocol import (
-                clear_link_shaping_overrides, set_drop_rate_override)
+                clear_corruption_overrides, clear_link_shaping_overrides,
+                set_drop_rate_override)
             set_drop_rate_override(None)
             clear_link_shaping_overrides()
+            clear_corruption_overrides()
 
     def __enter__(self) -> "ChaosEngine":
         return self

@@ -35,8 +35,10 @@ import numpy as np
 
 from geomx_tpu.service.protocol import (Msg, MsgType, _log_msg,
                                         _verbose_level, connect_retry,
-                                        env_int, recv_frame, send_frame,
+                                        env_int, maybe_corrupt_frame,
+                                        recv_frame, send_frame,
                                         wire_stats)
+from geomx_tpu.service.retry import SeededBackoff, count_retry
 
 
 class _RelayConnectError(OSError):
@@ -60,7 +62,8 @@ class GeoPSClient:
                  resend_timeout_ms: Optional[int] = None,
                  auto_pull: bool = False,
                  p3_slice_elems: Optional[int] = None,
-                 ts_node: Optional[int] = None):
+                 ts_node: Optional[int] = None,
+                 reconnect: Optional[bool] = None):
         """``auto_pull=True`` registers this client for server-initiated
         updates (the TSEngine AutoPull path): after each aggregation round
         the server pushes fresh values in throughput-scheduled order, and
@@ -70,9 +73,45 @@ class GeoPSClient:
         TSEngine push-side overlay: ``ts_push`` announces a ready partial
         via ASK1 and a relay listener accepts peers' partials, which are
         merged and re-announced — the scheduler-chosen aggregation tree of
-        the reference (kv_app.h:313-341, kvstore_dist.h:91-169)."""
+        the reference (kv_app.h:313-341, kvstore_dist.h:91-169).
+
+        ``reconnect`` (``GEOMX_RECONNECT``; default off) arms the
+        session-resume path of docs/resilience.md "Host-plane recovery":
+        a dead socket is re-dialed (seeded-jitter backoff, bounded by
+        ``GEOMX_RECONNECT_TIMEOUT_S``), the server's generation token is
+        compared to detect a *restart*, and on restart the client
+        re-syncs its per-key round ids (``query_progress``) and
+        idempotently re-pushes the retained in-flight round instead of
+        wedging every caller on ``ConnectionError("server closed")``.
+        Implies resend (the retransmit dedup the replay rides on)."""
         self.sender_id = sender_id
         self.addr = addr
+        if reconnect is None:
+            reconnect = bool(env_int(("GEOMX_RECONNECT",), 0))
+        self._reconnect = bool(reconnect)
+        self._reconnect_timeout_s = float(env_int(
+            ("GEOMX_RECONNECT_TIMEOUT_S",), 30))
+        if self._reconnect and resend_timeout_ms is None and not env_int(
+                ("GEOMX_RESEND", "PS_RESEND"), 0):
+            # reconnect without resend could double-merge a replayed
+            # push (no (sender, rid) dedup on the wire): force it on
+            resend_timeout_ms = env_int(
+                ("GEOMX_RESEND_TIMEOUT", "PS_RESEND_TIMEOUT"), 1000)
+        # connection-liveness latch: cleared while a reconnect is in
+        # flight; the send loop parks on it instead of dying.
+        # _conn_dead latches when reconnection gives up for good.
+        self._conn_ok = threading.Event()
+        self._conn_ok.set()
+        self._conn_dead = False
+        self._closing = threading.Event()
+        # last server generation token seen in any reply — the restart
+        # detector of the session-resume handshake
+        self._server_gen: Optional[int] = None
+        # key -> (round, clean frame, priority): the most recent push
+        # per key, retained (reconnect mode only) so a round the dead
+        # server incarnation lost can be re-pushed verbatim
+        self._last_push: Dict[str, tuple] = {}
+        self._registered_autopull = bool(auto_pull)
         self._autopull: Dict[str, Any] = {}
         self._apevents: Dict[str, threading.Event] = {}
         self._aplock = threading.Lock()
@@ -97,6 +136,17 @@ class GeoPSClient:
         self.p3_slice_elems = p3_slice_elems
         self._slicer = None
         if p3_slice_elems:
+            if self._reconnect:
+                # session resume retains ONE whole-tensor frame per key
+                # for the in-flight re-push; a P3-chunked push has no
+                # such frame, so a restarted server's lost round would
+                # wedge silently — refuse the combination loudly until
+                # chunk-set retention exists
+                raise ValueError(
+                    "GEOMX_RECONNECT does not compose with P3 push "
+                    "chunking (GEOMX_ENABLE_P3 / p3_slice_elems): the "
+                    "in-flight-round re-push retains whole-tensor "
+                    "frames only. Disable one of the two.")
             from geomx_tpu.transport import P3Slicer
             self._slicer = P3Slicer(p3_slice_elems)
         self._multi: Dict[int, list] = {}   # meta-rid -> per-chunk rids
@@ -199,6 +249,7 @@ class GeoPSClient:
                                or "127.0.0.1")
                 else:
                     adv = bind_host
+            self._relay_adv_host = adv
             self._request(Msg(MsgType.COMMAND,
                               meta={"cmd": "ts_register", "node": ts_node,
                                     "host": adv, "port": self.relay_port}))
@@ -226,12 +277,37 @@ class GeoPSClient:
                 return
             self._send_gate.wait()
             frame = item[0] if self._native_q else item
-            with self._wlock:
-                try:
-                    self._sock.sendall(
-                        len(frame).to_bytes(4, "little") + frame)
-                except OSError:
+            while True:
+                with self._wlock:
+                    sock = self._sock
+                    try:
+                        sock.sendall(
+                            len(frame).to_bytes(4, "little") + frame)
+                        sent = True
+                    except OSError:
+                        sent = False
+                if sent:
+                    break
+                if not self._reconnect or self._closed:
                     return
+                # session resume: the recv loop owns re-dialing; make
+                # sure it notices the breakage (it may be parked in a
+                # recv on the same dead socket), then park here until
+                # the connection is re-established and retry THIS frame
+                # on the fresh socket — the server dedups replays
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if not self._conn_ok.wait(
+                        self._reconnect_timeout_s + 5.0) or self._closed \
+                        or self._conn_dead:
+                    return
+                if self._sock is sock:
+                    # the recv loop hasn't begun the swap yet (the latch
+                    # is still set from before the breakage): don't hot-
+                    # spin close/send on the same dead socket
+                    time.sleep(0.01)
             wire_stats.add_sent(len(frame) + 4)
 
     def _recv_loop(self):
@@ -240,15 +316,27 @@ class GeoPSClient:
                 msg = recv_frame(self._sock)
             except (OSError, pickle.UnpicklingError, ValueError):
                 # ValueError/UnpicklingError = malformed or rejected frame
-                # (see protocol._HeaderUnpickler); after it the stream
+                # (see protocol._HeaderUnpickler) and FrameIntegrityError
+                # = failed CRC/length check; after any of them the stream
                 # position is untrustworthy, so treat like a dead socket —
-                # falling through releases every waiter
+                # falling through reconnects or releases every waiter
                 msg = None
             if msg is None:
-                # connection closed: release every waiter.  Entries stay in
-                # the dict — wait() pops them — so a reply that landed just
-                # before the close is still consumable (reply set + event
-                # fired), instead of being wiped into a KeyError.
+                # session resume (docs/resilience.md): re-dial, detect a
+                # server restart via the generation token, re-sync round
+                # ids and replay what the dead incarnation lost; the
+                # resendable waiters stay parked (their frames re-fly),
+                # so a mid-run restart is a stall, not an error
+                if self._reconnect and not self._closed \
+                        and self._reestablish():
+                    continue
+                # connection closed for good: release every waiter.
+                # Entries stay in the dict — wait() pops them — so a
+                # reply that landed just before the close is still
+                # consumable (reply set + event fired), instead of being
+                # wiped into a KeyError.
+                self._conn_dead = True
+                self._conn_ok.set()  # a parked sender must exit, not hang
                 with self._plock:
                     for p in self._pending.values():
                         p.event.set()
@@ -259,6 +347,12 @@ class GeoPSClient:
                     for ev in self._apevents.values():
                         ev.set()
                 return
+            gen = msg.meta.get("gen")
+            if gen is not None:
+                # every server/scheduler reply carries its generation
+                # token; recording it is what makes the NEXT reconnect
+                # able to tell "socket churn" from "process restart"
+                self._server_gen = gen
             if msg.type == MsgType.TS_DIRECTIVE:
                 # scheduler decided where this node's partial goes; the
                 # dispatcher thread moves the data (never the recv loop)
@@ -312,6 +406,143 @@ class GeoPSClient:
         return Msg(MsgType.PULL_REPLY, key=msg.key,
                    meta={"rid": msg.meta.get("rid")}, array=out)
 
+    # ---- session resume (docs/resilience.md "Host-plane recovery") --------
+
+    def _reestablish(self) -> bool:
+        """Re-dial the server with seeded-jitter backoff, run the
+        resume handshake, swap the socket in, and replay pending
+        resendable frames.  Runs on the recv thread (the send loop is
+        parked on ``_conn_ok``).  Returns False when the window
+        (``GEOMX_RECONNECT_TIMEOUT_S``) expires or the client closed —
+        the caller then fails the waiters exactly as before."""
+        self._conn_ok.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        backoff = SeededBackoff(seed=0x5E55 + self.sender_id,
+                                base_s=0.05, max_s=1.0)
+        deadline = time.monotonic() + self._reconnect_timeout_s
+        first = True
+        while not self._closed:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return False
+            if not first:
+                count_retry("reconnect")
+                if self._closing.wait(min(backoff.next(), remain)):
+                    return False
+            first = False
+            try:
+                sock = socket.create_connection(
+                    self.addr, timeout=min(5.0, max(0.2, remain)))
+            except OSError:
+                continue
+            try:
+                self._resume_session(sock)
+            except (OSError, ValueError, pickle.UnpicklingError,
+                    RuntimeError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            with self._wlock:
+                self._sock = sock
+            self._replay_pending()
+            self._conn_ok.set()
+            return True
+        return False
+
+    def _direct_rpc(self, sock: socket.socket, msg: Msg) -> Msg:
+        """One synchronous request on a NOT-yet-installed socket (the
+        resume handshake runs before the recv loop owns it).  Stray
+        server-initiated frames that arrive meanwhile (AUTOPULL,
+        TS directives) are parked where the recv loop would put them."""
+        msg.sender = self.sender_id
+        rid = next(self._rid)
+        msg.meta["rid"] = rid
+        send_frame(sock, msg)
+        while True:
+            rep = recv_frame(sock)
+            if rep is None:
+                raise ConnectionError("server closed during resume")
+            if rep.type == MsgType.AUTOPULL:
+                with self._aplock:
+                    self._autopull[rep.key] = (
+                        rep.meta.get("version", 0), rep.array)
+                    ev = self._apevents.setdefault(rep.key,
+                                                   threading.Event())
+                ev.set()
+                continue
+            if rep.type == MsgType.TS_DIRECTIVE:
+                self._ts_directives.put(rep)
+                continue
+            if rep.meta.get("rid") != rid:
+                continue  # a late reply to a pre-crash request
+            if rep.type == MsgType.ERROR:
+                raise RuntimeError(rep.meta.get("error", "resume failed"))
+            return rep
+
+    def _resume_session(self, sock: socket.socket) -> None:
+        """The handshake itself: learn the server's generation token;
+        on a RESTART (token changed), fetch the per-sender merged-round
+        counts and re-push any retained round the dead incarnation
+        lost — the idempotent replay the per-key round-id dedup
+        (``_key_rounds`` / server ``query_progress``) was built for."""
+        sock.settimeout(10.0)
+        hello = self._direct_rpc(sock, Msg(MsgType.COMMAND,
+                                           meta={"cmd": "hello"}))
+        gen = hello.meta.get("gen")
+        restarted = (gen is not None and self._server_gen is not None
+                     and gen != self._server_gen)
+        if restarted:
+            rep = self._direct_rpc(sock, Msg(MsgType.COMMAND,
+                                             meta={"cmd": "query_progress"}))
+            prog = {str(k): int(v) for k, v in
+                    dict(rep.meta.get("progress", {})).items()}
+            for key, held in list(self._last_push.items()):
+                rnd, frame, prio = held
+                if prog.get(key, 0) < rnd:
+                    # the restarted store is behind this client: the
+                    # in-flight round died with the old incarnation —
+                    # re-push the retained frame (deduped by
+                    # (sender, rid) if it actually survived)
+                    self._sendq.push(frame, prio)
+            for key, srv_rnd in prog.items():
+                if srv_rnd > self._key_rounds.get(key, 0):
+                    # server persisted rounds whose ACKs we never saw:
+                    # adopt its count so future pushes take fresh ids
+                    self._key_rounds[key] = srv_rnd
+        # connection-scoped registrations live in server-side tables
+        # keyed by the (old, dead) conn — refresh them on EVERY re-dial
+        if self._registered_autopull:
+            self._direct_rpc(sock, Msg(MsgType.COMMAND,
+                                       meta={"cmd": "register_autopull"}))
+        if self.ts_node is not None:
+            self._direct_rpc(sock, Msg(
+                MsgType.COMMAND,
+                meta={"cmd": "ts_register", "node": self.ts_node,
+                      "host": self._relay_adv_host,
+                      "port": self.relay_port}))
+        if gen is not None:
+            self._server_gen = gen
+        sock.settimeout(None)
+
+    def _replay_pending(self) -> None:
+        """Re-queue every un-answered resendable frame on the fresh
+        connection (the server dedups replays); non-resendable control
+        requests (INIT/COMMAND/BARRIER) fail fast with the
+        ConnectionError they always got."""
+        with self._plock:
+            for p in self._pending.values():
+                if p.event.is_set():
+                    continue
+                if p.frame is not None:
+                    self._sendq.push(p.frame, p.priority)
+                else:
+                    p.event.set()
+
     def _submit(self, msg: Msg, priority: int = 0,
                 fire_and_forget: bool = False) -> int:
         """Enqueue a request; returns its timestamp (request id).
@@ -326,7 +557,7 @@ class GeoPSClient:
             frame = msg.encode()
             if _verbose_level() >= 2:  # data-path sends log at ENQUEUE
                 _log_msg("ENQ ", msg, len(frame))
-            self._sendq.push(frame, priority)
+            self._sendq.push(maybe_corrupt_frame(msg, frame), priority)
             return rid
         p = _Pending()
         # only data messages are retransmitted: PUSH is deduped server-side
@@ -346,9 +577,20 @@ class GeoPSClient:
             _log_msg("ENQ ", msg, len(frame))
         if resendable:
             p.frame, p.priority = frame, priority
+        if self._reconnect and msg.type == MsgType.PUSH \
+                and msg.meta.get("round") is not None \
+                and msg.meta.get("chunk") is None:
+            # session resume: retain the CLEAN frame of the newest push
+            # per key, so a round a restarted server lost can be
+            # re-pushed verbatim (one gradient per key of memory)
+            self._last_push[msg.key] = (int(msg.meta["round"]), frame,
+                                        priority)
         with self._plock:
             self._pending[rid] = p
-        self._sendq.push(frame, priority)
+        # chaos ``corrupt@``: the queued copy may get one bit flipped;
+        # the retained p.frame / _last_push copies stay clean, so the
+        # retry path re-delivers an intact frame
+        self._sendq.push(maybe_corrupt_frame(msg, frame), priority)
         return rid
 
     def pause_sending(self) -> None:
@@ -417,6 +659,7 @@ class GeoPSClient:
                 ok = p.event.wait(w)
                 if ok:
                     break
+                count_retry("resend")
                 self._sendq.push(p.frame, p.priority)  # retransmit
         with self._plock:
             self._pending.pop(rid, None)
@@ -902,7 +1145,14 @@ class GeoPSClient:
             retries = int(os.environ.get("GEOMX_RELAY_RETRIES", "3"))
             t0 = time.monotonic()
             delivered = False
-            for _attempt in range(1 + retries):
+            backoff = SeededBackoff(seed=(self.ts_node or 0) * 131 + seq,
+                                    base_s=0.05, max_s=0.5)
+            for attempt in range(1 + retries):
+                if attempt:
+                    # shared retry discipline (service/retry.py): count
+                    # it, then the seeded-jitter pause
+                    count_retry("ts_relay")
+                    time.sleep(backoff.next())
                 try:
                     self._relay_send(addr, key, arr, m, seq)
                     delivered = True
@@ -1059,6 +1309,8 @@ class GeoPSClient:
         if self._closed:
             return
         self._closed = True
+        self._closing.set()     # abort an in-flight reconnect promptly
+        self._conn_ok.set()     # ... and a sender parked on it
         self._send_gate.set()  # release a paused sender so it can exit
         self._sendq.close()
         try:

@@ -1,14 +1,27 @@
-"""Wire protocol: length-prefixed frames, numpy payloads.
+"""Wire protocol: length-prefixed, CRC-protected frames, numpy payloads.
 
 The reference serializes ps-lite Meta via protobuf plus raw SArray data
 (3rdparty/ps-lite/include/ps/internal/message.h, src/meta.pb.cc).  Here a
 frame is:
 
+    [u8 version|flags][u32 crc32 of the rest]
     [u32 header_len][header: pickled dict][payload bytes]
 
 with tensor payloads as raw little-endian numpy bytes described by
 header["dtype"]/header["shape"].  Pickle never carries user code — headers
 are dicts of primitives only (enforced in Msg).
+
+Integrity (docs/resilience.md "Host-plane recovery"): the version/flags
+byte + CRC32 prelude rides EVERY frame, so one flipped bit on a WAN
+link is *detected* (THC, PAPERS.md: compressed-domain streams amplify
+exactly this class of silent corruption) instead of silently corrupting
+a gradient — a bad frame raises :class:`FrameIntegrityError`, which the
+serve/recv loops treat as a dead connection (drop + the client's
+retry/reconnect path), never a tier crash.  ``recv_frame`` additionally
+bounds the 4-byte length prefix at ``GEOMX_MAX_FRAME_BYTES`` (default
+1 GiB) so a corrupted length can no longer drive ``_recv_exact`` into
+an unbounded allocation.  Both rejections count in
+``geomx_wire_crc_errors_total{reason}``.
 """
 
 from __future__ import annotations
@@ -21,12 +34,73 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 _LEN = struct.Struct("<I")
+
+# frame prelude: one version/flags byte (upper nibble = flags, all zero
+# today) + CRC32 over everything after the prelude
+FRAME_VERSION = 0x01
+_PRELUDE = 5  # 1 version byte + 4 CRC bytes
+
+DEFAULT_MAX_FRAME_BYTES = 1 << 30  # 1 GiB
+
+
+class FrameIntegrityError(ConnectionError):
+    """A frame failed its CRC / version / length-bound check.  Subclass
+    of ConnectionError so every existing serve/recv loop routes it into
+    the drop-the-connection path it already has for dead sockets."""
+
+
+_max_frame_cache: Optional[int] = None
+
+
+def max_frame_bytes() -> int:
+    """``GEOMX_MAX_FRAME_BYTES`` (cached like the verbose level; tests
+    call :func:`reset_frame_limit_cache`)."""
+    global _max_frame_cache
+    if _max_frame_cache is None:
+        _max_frame_cache = max(1, env_int(("GEOMX_MAX_FRAME_BYTES",),
+                                          DEFAULT_MAX_FRAME_BYTES))
+    return _max_frame_cache
+
+
+def reset_frame_limit_cache() -> None:
+    global _max_frame_cache
+    _max_frame_cache = None
+
+
+def _count_frame_error(reason: str) -> None:
+    """Bump ``geomx_wire_crc_errors_total{reason}`` and surface the
+    incident to the flight recorder / event log (telemetry imported
+    lazily — this only runs on the error path, and the registry is
+    resolved per call so test-time registry resets never orphan it)."""
+    try:
+        from geomx_tpu.telemetry import get_registry
+        get_registry().counter(
+            "geomx_wire_crc_errors_total",
+            "Wire frames rejected by the integrity layer "
+            "(CRC mismatch, unknown version, length bound)",
+            ("reason",)).labels(reason=reason).inc()
+        from geomx_tpu.telemetry.flight import notify_host_incident
+        notify_host_incident("wire_crc_error", reason=reason)
+    except Exception:
+        pass  # the integrity REJECTION must stand even if telemetry
+        # is mid-teardown; the counter is observability, not the gate
+
+
+def wire_crc_errors() -> float:
+    """Total frames rejected by the integrity layer so far (all
+    reasons) — what the corrupt@ chaos acceptance asserts is nonzero."""
+    from geomx_tpu.telemetry import get_registry
+    fam = get_registry().get("geomx_wire_crc_errors_total")
+    if fam is None:
+        return 0.0
+    return float(sum(child.value for _lbl, child in fam.children()))
 
 _ALLOWED_HEADER_TYPES = (str, int, float, bool, bytes, type(None), list,
                          tuple, dict)
@@ -90,6 +164,11 @@ class Msg:
             raise ValueError(f"disallowed meta type {type(obj)}")
 
     def encode(self) -> bytes:
+        """Wire frame WITH the integrity prelude: ``[u8 version|flags]
+        [u32 crc32(body)] [u32 header_len][header][payload]``.  Every
+        producer (send_frame, the client/server priority send queues)
+        ships ``encode()`` output verbatim, so the CRC covers exactly
+        what crosses the wire."""
         self._check_meta(self.meta)
         header = {"t": int(self.type), "k": self.key, "s": self.sender,
                   "m": self.meta}
@@ -100,15 +179,39 @@ class Msg:
             header["shape"] = arr.shape
             payload = arr.tobytes()
         hb = pickle.dumps(header, protocol=4)
-        return _LEN.pack(len(hb)) + hb + payload
+        body = _LEN.pack(len(hb)) + hb + payload
+        return (bytes((FRAME_VERSION,)) + _LEN.pack(zlib.crc32(body))
+                + body)
 
     @classmethod
     def decode(cls, frame: bytes) -> "Msg":
-        hlen = _LEN.unpack_from(frame, 0)[0]
-        header = _header_loads(frame[4:4 + hlen])
+        """Verify-and-parse.  Every frame MUST carry the version/flags
+        byte and a matching CRC32 — there is deliberately no bare-frame
+        fallback (a length-byte that happens to equal the version would
+        make the two formats ambiguous, and this repo's peers are
+        always in lockstep).  An unknown version or a CRC mismatch
+        raises :class:`FrameIntegrityError` (counted in
+        ``geomx_wire_crc_errors_total{reason}``): the connection drops
+        and the sender's retry path re-delivers."""
+        if len(frame) < _PRELUDE + _LEN.size or frame[0] != FRAME_VERSION:
+            _count_frame_error("version")
+            raise FrameIntegrityError(
+                f"wire frame version {frame[:1]!r} is not the supported "
+                f"{FRAME_VERSION:#x} (truncated, corrupted, or a "
+                "pre-integrity peer)")
+        want = _LEN.unpack_from(frame, 1)[0]
+        if zlib.crc32(frame[_PRELUDE:]) != want:
+            _count_frame_error("crc")
+            raise FrameIntegrityError(
+                "wire frame failed its CRC32 check (one or more "
+                "corrupted bits); dropping the connection so the "
+                "sender's retry path re-delivers")
+        off = _PRELUDE
+        hlen = _LEN.unpack_from(frame, off)[0]
+        header = _header_loads(frame[off + 4:off + 4 + hlen])
         arr = None
         if "dtype" in header:
-            arr = np.frombuffer(frame[4 + hlen:],
+            arr = np.frombuffer(frame[off + 4 + hlen:],
                                 dtype=np.dtype(header["dtype"]))
             arr = arr.reshape(header["shape"])
         return cls(type=MsgType(header["t"]), key=header["k"],
@@ -139,6 +242,62 @@ def reseed_drop_rng(seed: int) -> None:
     """Reseed the shared drop RNG: a seeded chaos schedule reproduces
     the exact message-loss pattern run to run."""
     _drop_rng.seed(seed)
+
+
+# chaos bit-corruption epochs (resilience/chaos.py ``corrupt@``): the
+# in-process sender-side override the data path consults, installed and
+# cleared by the chaos engine exactly like the drop-rate override.  A
+# corrupted frame keeps its CRC of the ORIGINAL bytes, so the receiver's
+# integrity check fails, the connection drops, and the sender's
+# retry/reconnect path re-delivers a clean copy — the end-to-end story
+# the wire-CRC gate exists to prove.  Keyed by wire sender id (the
+# bench's workers use party == sender_id); -1 matches every sender.
+_corrupt_rates: "dict[int, int]" = {}
+_corrupt_rng = _random.Random(0xC0DE)
+
+
+def set_corruption_override(party, rate) -> None:
+    """Install (0-100) or clear (None) the corruption rate for wire
+    sender ``party`` (-1 = all senders)."""
+    p = int(party)
+    if rate is None:
+        _corrupt_rates.pop(p, None)
+    else:
+        _corrupt_rates[p] = max(0, min(100, int(rate)))
+
+
+def clear_corruption_overrides() -> None:
+    _corrupt_rates.clear()
+
+
+def reseed_corrupt_rng(seed: int) -> None:
+    """Seeded corruption patterns, like :func:`reseed_drop_rng`."""
+    _corrupt_rng.seed(seed)
+
+
+def maybe_corrupt_frame(msg: "Msg", frame: bytes) -> bytes:
+    """Fault injection at the sender: with the configured probability,
+    flip one random bit of an encoded frame's CRC-covered region.  Only
+    retry-protected data traffic is eligible (``meta["resend"]`` /
+    ``best_effort``, never ``reliable`` or control frames) — the same
+    discipline :func:`should_drop` enforces, because corruption without
+    a retry path would wedge a tier instead of testing its recovery.
+    The flip lands at offset >= 1 so the version byte survives and the
+    receiver takes the CRC-checked parse, not the legacy fallback."""
+    if not _corrupt_rates:
+        return frame
+    if msg.type not in (MsgType.PUSH, MsgType.PULL):
+        return frame
+    if not (msg.meta.get("resend") or msg.meta.get("best_effort")) \
+            or msg.meta.get("reliable"):
+        return frame
+    rate = _corrupt_rates.get(int(msg.sender), _corrupt_rates.get(-1, 0))
+    if rate <= 0 or _corrupt_rng.random() * 100.0 >= rate:
+        return frame
+    buf = bytearray(frame)
+    i = _corrupt_rng.randrange(1, len(buf))
+    buf[i] ^= 1 << _corrupt_rng.randrange(8)
+    return bytes(buf)
 
 
 # chaos link-quality shaping (resilience/chaos.py `throttle@`/`delay@`):
@@ -254,7 +413,15 @@ def connect_retry(addr, total_timeout_s: float = 30.0,
     strictly ordered (the launcher starts tiers with best-effort delays;
     ssh + interpreter start times vary), so peers wait for their server to
     come up instead of dying on the first ConnectionRefused — the same
-    spin the reference's Van does waiting for the scheduler."""
+    spin the reference's Van does waiting for the scheduler.  Retries go
+    through the shared seeded-jitter discipline (service/retry.py):
+    counted in ``geomx_rpc_retries_total{op="connect"}``, jitter seeded
+    from the target address so co-starting peers decorrelate while any
+    one peer's timing stays reproducible."""
+    from geomx_tpu.service.retry import SeededBackoff, count_retry
+    backoff = SeededBackoff(seed=zlib.crc32(repr(addr).encode()),
+                            base_s=interval_s, factor=1.0,
+                            max_s=max(interval_s, 0.25), jitter=0.5)
     deadline = time.monotonic() + total_timeout_s
     while True:
         try:
@@ -270,7 +437,8 @@ def connect_retry(addr, total_timeout_s: float = 30.0,
         except OSError:
             if time.monotonic() >= deadline:
                 raise
-            time.sleep(interval_s)
+            count_retry("connect")
+            time.sleep(backoff.next())
 
 
 class WireStats:
@@ -340,7 +508,7 @@ def _log_msg(direction: str, msg: Msg, nbytes: int) -> None:
 
 
 def send_frame(sock: socket.socket, msg: Msg) -> None:
-    data = msg.encode()
+    data = maybe_corrupt_frame(msg, msg.encode())
     sock.sendall(_LEN.pack(len(data)) + data)
     wire_stats.add_sent(len(data) + 4)
     if _verbose_level() >= 2:
@@ -352,6 +520,18 @@ def recv_frame(sock: socket.socket) -> Optional[Msg]:
     if head is None:
         return None
     (n,) = _LEN.unpack(head)
+    cap = max_frame_bytes()
+    if n > cap:
+        # a corrupted/hostile length prefix must not drive _recv_exact
+        # into an unbounded allocation: reject BEFORE allocating and
+        # drop the connection (the stream position is untrustworthy)
+        _count_frame_error("length")
+        import sys
+        print(f"[geomx-wire] rejected frame announcing {n} bytes "
+              f"(GEOMX_MAX_FRAME_BYTES={cap}); closing connection",
+              file=sys.stderr, flush=True)
+        raise FrameIntegrityError(
+            f"frame length {n} exceeds GEOMX_MAX_FRAME_BYTES={cap}")
     data = _recv_exact(sock, n)
     if data is None:
         return None

@@ -56,7 +56,9 @@ class GeoScheduler:
 
     def __init__(self, port: int = 0, bind_host: Optional[str] = None,
                  heartbeat_timeout: float = 15.0,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 durable_dir: Optional[str] = None,
+                 restart_grace_s: Optional[float] = None):
         self._lock = threading.Lock()
         # (role, host, port, tag) -> assigned id; survives re-registration
         # (tag disambiguates nodes with no serving port, e.g. workers
@@ -73,17 +75,55 @@ class GeoScheduler:
         self._epoch = 0
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
 
+        # ---- durability (docs/resilience.md "Host-plane recovery") -----
+        # roster, id table and epoch persist through the shared
+        # DurableStateStore so a restarted scheduler hands every
+        # re-registering node its OLD id (is_recovery) and the epoch
+        # keeps counting instead of resetting under the liveness plane.
+        # No jax import — the scheduler process stays jax-free.
+        import random as _rnd
+        self.generation = _rnd.getrandbits(31) | 1
+        self._durable = None
+        self._grace_until = 0.0
+        from geomx_tpu.resilience.durability import durable_dir_from_env
+        ddir = durable_dir_from_env(durable_dir)
+        if ddir:
+            from geomx_tpu.resilience.durability import DurableStateStore
+            self._durable = DurableStateStore(ddir, "scheduler")
+            self.generation = self._durable.bump_generation()
+            restored = self._restore_durable()
+            if restored and self.generation > 1:
+                self._announce_restart()
+                # re-registration grace window: live nodes whose
+                # heartbeats predate the restart must not be mass-
+                # evicted while they re-dial — seed their heartbeat
+                # identities fresh AND hold the dead list shut until
+                # the window passes
+                if restart_grace_s is None:
+                    from geomx_tpu.config import _env
+                    restart_grace_s = _env(("GEOMX_RESTART_GRACE_S",),
+                                           float(heartbeat_timeout), float)
+                self._grace_until = time.monotonic() + \
+                    max(0.0, float(restart_grace_s))
+                for entries in self._roster.values():
+                    for e in entries:
+                        self.heartbeats.heartbeat(int(e[0]))
+
         self._started_monotonic = time.monotonic()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if bind_host is None:
             # graftlint: disable=GXL006 — host-plane knob
             bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
-        self._srv.bind((bind_host, port))
+        # a restart onto the crashed predecessor's port races its
+        # teardown — wait it out like a supervisor would
+        from geomx_tpu.service.server import GeoPSServer
+        GeoPSServer._bind_with_retry(self._srv, bind_host, port)
         self._srv.listen(64)
         self._srv.settimeout(0.2)
         self.port = self._srv.getsockname()[1]
         self._running = True
+        self._conns: set = set()
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
 
@@ -143,6 +183,88 @@ class GeoScheduler:
         if metrics_port is not None:
             self._start_metrics_http(bind_host, int(metrics_port))
 
+    # ---- durability --------------------------------------------------------
+
+    def _announce_restart(self):
+        from geomx_tpu.telemetry.flight import announce_host_restart
+        announce_host_restart(
+            "scheduler", self.generation, "scheduler_restart",
+            epoch=self._epoch,
+            nodes=sum(len(v) for v in self._roster.values()))
+        from geomx_tpu.utils.profiler import get_profiler
+        get_profiler().instant(
+            "SchedulerRestart", "scheduler",
+            args={"generation": self.generation, "epoch": self._epoch})
+
+    def _durable_state_locked(self) -> dict:
+        return {"assigned": [[list(k), v]
+                             for k, v in self._assigned.items()],
+                "roster": {r: [list(e) for e in v]
+                           for r, v in self._roster.items()},
+                "next": dict(self._next),
+                "epoch": self._epoch}
+
+    def _journal(self, rec: dict) -> None:
+        """Append one roster mutation; caller holds self._lock.  The
+        roster is tiny, so compaction is cheap and frequent."""
+        if self._durable is None:
+            return
+        self._durable.append(rec)
+        if self._durable.records_appended % 64 == 0:
+            self._durable.compact(self._durable_state_locked())
+
+    def _restore_durable(self) -> bool:
+        snap, records = self._durable.load()
+        if snap is None and not records:
+            return False
+        state = snap or {"assigned": [], "roster": {}, "next": {},
+                         "epoch": 0}
+        self._assigned = {tuple(k): int(v)
+                          for k, v in state.get("assigned", [])}
+        self._roster = {r: [tuple(e) for e in v]
+                        for r, v in state.get("roster", {}).items()}
+        self._next.update({k: int(v)
+                           for k, v in state.get("next", {}).items()})
+        self._epoch = int(state.get("epoch", 0))
+        for rec in records:
+            self._apply_durable_record(rec)
+        return True
+
+    def _apply_durable_record(self, rec: dict) -> None:
+        kind = rec.get("k")
+        if kind == "register":
+            key = tuple(rec["key"])
+            node_id = int(rec["id"])
+            # an id claimed under a NEW key releases its old binding
+            # (explicit prev_id recovery moved the identity)
+            for k0, v0 in list(self._assigned.items()):
+                if v0 == node_id and k0 != key:
+                    del self._assigned[k0]
+            self._assigned[key] = node_id
+            role = key[0]
+            entries = [e for e in self._roster.get(role, [])
+                       if e[0] != node_id]
+            entries.append(tuple(rec["entry"]))
+            self._roster[role] = sorted(entries)
+            self._next[role] = max(self._next.get(role, 0),
+                                   node_id + 2)
+            self._epoch = max(self._epoch, int(rec.get("epoch", 0)))
+        elif kind == "evict":
+            node = int(rec["node"])
+            for role, entries in list(self._roster.items()):
+                self._roster[role] = [e for e in entries
+                                      if e[0] != node]
+            for k0, v0 in list(self._assigned.items()):
+                if v0 == node:
+                    del self._assigned[k0]
+            self._epoch = max(self._epoch, int(rec.get("epoch", 0)))
+
+    def in_restart_grace(self) -> bool:
+        """True while the post-restart re-registration grace window is
+        open: the dead list stays shut so a restart cannot mass-evict
+        live parties that simply haven't re-heartbeated yet."""
+        return time.monotonic() < self._grace_until
+
     def health_snapshot(self) -> dict:
         """The ``GET /healthz`` body: roster epoch, per-role roster
         sizes, live/dead party counts from the heartbeat monitor,
@@ -153,7 +275,8 @@ class GeoScheduler:
             roster = {role: len(nodes)
                       for role, nodes in sorted(self._roster.items())}
         alive = self.heartbeats.alive_nodes()
-        dead = self.heartbeats.dead_nodes()
+        dead = [] if self.in_restart_grace() \
+            else self.heartbeats.dead_nodes()
         return {
             "status": "ok",
             "roster_epoch": epoch,
@@ -161,6 +284,8 @@ class GeoScheduler:
             "live_parties": len(alive),
             "dead_parties": len(dead),
             "dead_node_ids": dead,
+            "restart_grace": self.in_restart_grace(),
+            "generation": self.generation,
             "uptime_s": round(time.monotonic() - self._started_monotonic,
                               3),
             "build": dict(self.build_info),
@@ -235,6 +360,33 @@ class GeoScheduler:
             self._srv.close()
         except OSError:
             pass
+        if self._durable is not None:
+            self._durable.close()
+        if self._metrics_srv is not None:
+            try:
+                self._metrics_srv.shutdown()
+                self._metrics_srv.server_close()
+            except OSError:
+                pass
+
+    def crash(self):
+        """In-process emulation of a scheduler process death (chaos
+        ``kill@...node=scheduler``): sever the listener AND every live
+        connection abruptly so clients see exactly what a SIGKILL gives
+        them.  Only the durable store survives; a replacement built on
+        the same durable dir (and port) is the restart."""
+        self._running = False
+        for sock in [self._srv] + list(self._conns):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._durable is not None:
+            self._durable.close()
         if self._metrics_srv is not None:
             try:
                 self._metrics_srv.shutdown()
@@ -256,10 +408,27 @@ class GeoScheduler:
             except OSError:
                 return
             conn.settimeout(None)
+            self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
     def _serve(self, conn: socket.socket):
+        try:
+            self._serve_loop(conn)
+        finally:
+            # close actively (see GeoPSServer._serve_conn): a frame-
+            # integrity drop must read as a dead socket on the peer
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._conns.discard(conn)
+
+    def _serve_loop(self, conn: socket.socket):
         while True:
             try:
                 msg = recv_frame(conn)
@@ -283,6 +452,8 @@ class GeoScheduler:
         rid = req.meta.get("rid")
         if rid is not None:
             reply.meta["rid"] = rid
+        # restart detector: same token discipline as GeoPSServer
+        reply.meta.setdefault("gen", self.generation)
         send_frame(conn, reply)
 
     def _roster_gauges_locked(self) -> None:
@@ -339,6 +510,10 @@ class GeoScheduler:
                 self._roster[role] = sorted(entries)
                 self._epoch += 1
                 epoch = self._epoch
+                self._journal({"k": "register", "key": list(key),
+                               "id": node_id,
+                               "entry": [node_id, host, port, tag],
+                               "epoch": epoch})
                 roster = {r: list(v) for r, v in self._roster.items()}
                 self._roster_gauges_locked()
                 # inside the lock: concurrent register/evict handlers
@@ -380,6 +555,8 @@ class GeoScheduler:
                         del self._assigned[k]
                 if evicted:
                     self._epoch += 1
+                    self._journal({"k": "evict", "node": node,
+                                   "epoch": self._epoch})
                 epoch = self._epoch
                 self._roster_gauges_locked()
                 if evicted:
@@ -418,9 +595,14 @@ class GeoScheduler:
             self._reply(conn, msg, Msg(MsgType.ACK, meta={
                 "text": render_prometheus()}))
         elif cmd == "num_dead_nodes":
+            # restart grace: a freshly-restored scheduler answers an
+            # empty dead list until live nodes had time to re-dial —
+            # otherwise one scheduler restart would read as a mass
+            # party death to every liveness consumer
+            dead = [] if self.in_restart_grace() else \
+                self.heartbeats.dead_nodes(msg.meta.get("timeout"))
             self._reply(conn, msg, Msg(MsgType.ACK, meta={
-                "dead": self.heartbeats.dead_nodes(
-                    msg.meta.get("timeout"))}))
+                "dead": dead, "grace": self.in_restart_grace()}))
         else:
             self._reply(conn, msg, Msg(MsgType.ERROR,
                                        meta={"error": f"bad cmd {cmd}"}))
@@ -437,15 +619,46 @@ class SchedulerClient:
         self.node_id: Optional[int] = None
         self.is_recovery = False
         self.roster_epoch = 0   # last roster epoch seen (resilience/)
+        # restart detection (generation token in every scheduler reply)
+        self.scheduler_generation: Optional[int] = None
+        self.saw_scheduler_restart = False
         self._hb_stop: Optional[threading.Event] = None
         self._hb_sock: Optional[socket.socket] = None
 
-    def _rpc(self, msg: Msg) -> Msg:
-        with self._lock:
-            send_frame(self._sock, msg)
-            reply = recv_frame(self._sock)
-        if reply is None:
-            raise ConnectionError("scheduler closed")
+    def _rpc(self, msg: Msg, retry: bool = True) -> Msg:
+        """One synchronous exchange.  ``retry=True`` (everything except
+        barrier, which must not enter a group twice) re-dials a dead
+        scheduler once — register/cluster/evict/heartbeat are
+        idempotent, and a RESTARTED scheduler restored its roster from
+        the durable store, so the retried call lands on continuous
+        state (docs/resilience.md "Host-plane recovery")."""
+        for attempt in (0, 1):
+            try:
+                with self._lock:
+                    send_frame(self._sock, msg)
+                    reply = recv_frame(self._sock)
+                if reply is None:
+                    raise ConnectionError("scheduler closed")
+                break
+            except (OSError, ConnectionError, ValueError,
+                    pickle.UnpicklingError):
+                if not retry or attempt:
+                    raise
+                from geomx_tpu.service.retry import count_retry
+                count_retry("scheduler_rpc")
+                with self._lock:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = connect_retry(self._addr,
+                                               total_timeout_s=15.0)
+        gen = reply.meta.get("gen")
+        if gen is not None:
+            if self.scheduler_generation is not None \
+                    and gen != self.scheduler_generation:
+                self.saw_scheduler_restart = True
+            self.scheduler_generation = gen
         if reply.type == MsgType.ERROR:
             raise RuntimeError(reply.meta.get("error", "scheduler error"))
         return reply
@@ -498,7 +711,8 @@ class SchedulerClient:
         self._sock.settimeout(timeout)
         try:
             reply = self._rpc(Msg(MsgType.COMMAND, meta={
-                "cmd": "barrier", "group": group, "expect": expect}))
+                "cmd": "barrier", "group": group, "expect": expect}),
+                retry=False)  # re-entering a barrier would double-count
             if reply.type != MsgType.BARRIER_RELEASE:
                 raise ConnectionError(f"barrier failed: {reply}")
         finally:
@@ -582,7 +796,9 @@ class SchedulerClient:
 
     def stop_scheduler(self) -> None:
         try:
-            self._rpc(Msg(MsgType.STOP))
+            # no retry: re-dialing a scheduler that just honored the
+            # STOP would burn the whole connect window at teardown
+            self._rpc(Msg(MsgType.STOP), retry=False)
         except (ConnectionError, OSError):
             pass
 

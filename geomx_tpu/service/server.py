@@ -79,11 +79,25 @@ class GeoPSServer:
                  num_global_workers: int = 1,
                  bigarray_bound: Optional[int] = None,
                  inter_ts: Optional[bool] = None,
-                 global_ts_node: Optional[int] = None):
+                 global_ts_node: Optional[int] = None,
+                 durable_dir: Optional[str] = None,
+                 durable_name: Optional[str] = None,
+                 reconnect: Optional[bool] = None):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
-        is the GeoMX local-tier behavior (CopyFromTo merged->store)."""
+        is the GeoMX local-tier behavior (CopyFromTo merged->store).
+
+        ``durable_dir`` (``GEOMX_DURABLE_DIR``) arms the crash-recovery
+        plane (docs/resilience.md "Host-plane recovery"): the key store,
+        per-sender merged-round counts, optimizer config/state and
+        eviction roster persist through an atomic-snapshot +
+        append-journal :class:`~geomx_tpu.resilience.durability.
+        DurableStateStore`, a restarted process replays to its pre-crash
+        durable state, and every reply carries a per-start generation
+        token so clients detect the restart and run the session-resume
+        handshake.  ``reconnect`` arms that handshake on this server's
+        OWN upstream clients (the WAN relay to the global tier)."""
         self.num_workers = num_workers
         self.mode = mode
         self.accumulate = accumulate
@@ -277,6 +291,27 @@ class GeoPSServer:
             self._compressor = get_compressor(compression)
             self._comp_state: Dict[str, Any] = {}
 
+        # ---- durability (docs/resilience.md "Host-plane recovery") -----
+        # generation token: changes on every process start, rides every
+        # reply.  Without a durable dir it is a fresh random draw (so
+        # clients still DETECT a restart, they just cannot resume state);
+        # with one it is the store's persisted monotone counter.
+        import random as _rnd
+        self.generation = _rnd.getrandbits(31) | 1
+        self._durable = None
+        self._journal_since_compact = 0
+        self._upstream_reconnect = reconnect
+        from geomx_tpu.resilience.durability import durable_dir_from_env
+        ddir = durable_dir_from_env(durable_dir)
+        if ddir:
+            from geomx_tpu.resilience.durability import DurableStateStore
+            self._durable = DurableStateStore(
+                ddir, durable_name or f"ps_server_r{rank}")
+            self.generation = self._durable.bump_generation()
+            self._restore_durable()
+            if self.generation > 1:
+                self._announce_restart()
+
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # loopback by default (pseudo-distributed); multi-host deployments
@@ -284,7 +319,7 @@ class GeoPSServer:
         if bind_host is None:
             # graftlint: disable=GXL006 — host-plane knob
             bind_host = os.environ.get("GEOMX_PS_BIND_HOST", "127.0.0.1")
-        self._srv.bind((bind_host, port))
+        self._bind_with_retry(self._srv, bind_host, port)
         self._srv.listen(64)
         # a blocked accept() is not reliably woken by close() on Linux, so
         # poll with a short timeout and re-check _running
@@ -293,6 +328,25 @@ class GeoPSServer:
         self._running = True
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
+
+    @staticmethod
+    def _bind_with_retry(srv: socket.socket, host: str, port: int,
+                         window_s: float = 5.0) -> None:
+        """Bind, retrying EADDRINUSE for a short window when the port is
+        EXPLICIT: a restart onto a crashed predecessor's port races the
+        old socket's teardown (and TIME_WAIT), and a supervisor-style
+        replacement should wait it out instead of dying."""
+        import errno
+        deadline = time.monotonic() + window_s
+        while True:
+            try:
+                srv.bind((host, port))
+                return
+            except OSError as e:
+                if port == 0 or e.errno != errno.EADDRINUSE \
+                        or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -313,7 +367,8 @@ class GeoPSServer:
                     RuntimeWarning, stacklevel=2)
             self._gclients = [
                 GeoPSClient(addr, sender_id=self._global_sender_id,
-                            ts_node=self._global_ts_node if ts else None)
+                            ts_node=self._global_ts_node if ts else None,
+                            reconnect=self._upstream_reconnect)
                 for addr in self._global_addrs]
             for c in self._gclients:
                 # a RESTARTED local server must resume its global push
@@ -412,6 +467,36 @@ class GeoPSServer:
             except OSError:
                 pass
 
+    def crash(self):
+        """In-process emulation of a process death (the chaos ``kill@``
+        verb / SIGKILL): sever every socket abruptly — no STOP forward,
+        no drains, no graceful anything.  Whatever was only in memory
+        (the open round's partial merges) is lost; only the durable
+        store survives, exactly as for a real kill.  A replacement
+        server constructed on the same durable dir (and port) is the
+        restart."""
+        self._running = False
+        with self._lock:
+            for q in self._relay_qs.values():
+                q.put(None)
+        for sock in [self._srv] + list(self._conns):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for c in self._gclients:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._durable is not None:
+            self._durable.close()
+        self._stop_done.set()
+
     def join(self, timeout: Optional[float] = None):
         self._accept_thread.join(timeout)
         if not self._running:
@@ -420,6 +505,134 @@ class GeoPSServer:
             # caller exit the process.  Bounded so a stop() wedged in a
             # remote send can never hang the host process forever.
             self._stop_done.wait(timeout if timeout is not None else 60.0)
+
+    # ---- durability (atomic snapshot + append journal) ---------------------
+
+    def _announce_restart(self):
+        """Restored from a durable dir with generation > 1: this is a
+        restart.  Publish it (restart counter + generation gauge +
+        host-plane incident for the flight recorder / event log)."""
+        from geomx_tpu.telemetry.flight import announce_host_restart
+        announce_host_restart(f"server_r{self.rank}", self.generation,
+                              "server_restart", rank=self.rank,
+                              keys=len(self._store))
+        self.profiler.instant("ServerRestart", "kvstore",
+                              args={"rank": self.rank,
+                                    "generation": self.generation,
+                                    "keys": len(self._store)})
+
+    def _opt_blob(self, key: str) -> Optional[bytes]:
+        """Optimizer state as a host-tree blob (utils/checkpoint
+        tree_to_bytes — the one serialization checkpoints, catch-up and
+        now the durable journal share).  None when no optax state."""
+        if self._tx is None or key not in self._opt_state:
+            return None
+        from geomx_tpu.utils.checkpoint import tree_to_bytes
+        return tree_to_bytes(self._opt_state[key])
+
+    def _key_record(self, key: str, st: _KeyState) -> dict:
+        comp = None
+        if self._compressor is not None:
+            comp = self._comp_state.get(key)
+        return {"value": st.value, "round": st.round,
+                "pushed": dict(st.pushed), "milestone": st.milestone,
+                "opt": self._opt_blob(key), "comp": comp}
+
+    def _journal(self, rec: dict) -> None:
+        """Append one journal record; caller holds self._lock (or runs
+        pre-start).  Folds the journal into a fresh snapshot every
+        GEOMX_DURABLE_COMPACT records (256) OR once it outgrows
+        GEOMX_DURABLE_COMPACT_BYTES (64 MiB) — round records carry the
+        full key value + optimizer tree (correctness-first: replay
+        needs no delta algebra), so byte growth, not record count, is
+        what actually bounds big-key deployments."""
+        if self._durable is None:
+            return
+        self._durable.append(rec)
+        self._journal_since_compact += 1
+        if self._journal_since_compact >= env_int(
+                ("GEOMX_DURABLE_COMPACT",), 256) or \
+                self._durable.journal_bytes() >= env_int(
+                    ("GEOMX_DURABLE_COMPACT_BYTES",), 64 * 1024 * 1024):
+            self._durable.compact(self._durable_state_locked())
+            self._journal_since_compact = 0
+
+    def _journal_round(self, key: str, st: _KeyState) -> None:
+        """One completed merge round -> one durable record.  Called
+        BEFORE the round's pull replies go out (write-ahead: a value a
+        client may already have seen is always recoverable)."""
+        if self._durable is None:
+            return
+        rec = {"k": "round", "key": key}
+        rec.update(self._key_record(key, st))
+        self._journal(rec)
+
+    def _durable_state_locked(self) -> dict:
+        return {"keys": {key: self._key_record(key, st)
+                         for key, st in self._store.items()},
+                "num_workers": self.num_workers,
+                "evicted": sorted(self._evicted),
+                "tx_config": self._tx_config}
+
+    def _apply_durable_key(self, key: str, rec: dict) -> None:
+        st = self._store.get(key)
+        if st is None:
+            st = self._store[key] = _KeyState(np.asarray(rec["value"]))
+        st.value = np.asarray(rec["value"]).copy()
+        st.round = int(rec.get("round", 0))
+        st.pushed = {int(s): int(n)
+                     for s, n in dict(rec.get("pushed", {})).items()}
+        st.milestone = None if rec.get("milestone") is None \
+            else np.asarray(rec["milestone"]).copy()
+        st.merged, st.count = None, 0
+        st.rs_rows, st.rs_vals = [], []
+        blob = rec.get("opt")
+        if blob is not None and self._tx is not None:
+            from geomx_tpu.utils.checkpoint import tree_from_bytes
+            self._opt_state[key] = tree_from_bytes(blob)
+        elif self._tx is not None and key not in self._opt_state:
+            self._opt_state[key] = self._tx.init(st.value)
+        if self._compressor is not None:
+            comp = rec.get("comp")
+            self._comp_state[key] = comp if comp is not None else \
+                self._compressor.init_leaf_state(st.value)
+
+    def _restore_durable(self) -> None:
+        """Replay snapshot + journal into the in-memory store: the
+        restarted process resumes at its last DURABLE state (every
+        completed merge round).  The round that was in flight at the
+        crash is gone from memory by design — its pushers detect the
+        new generation and idempotently re-push it (session resume),
+        which re-opens the round."""
+        snap, records = self._durable.load()
+        state = snap or {"keys": {}, "num_workers": None,
+                         "evicted": [], "tx_config": None}
+        # fold journal records into the snapshot state first, so
+        # optimizer config lands before per-key opt blobs decode
+        for rec in records:
+            kind = rec.get("k")
+            if kind in ("init", "round"):
+                state["keys"][rec["key"]] = {
+                    f: rec.get(f) for f in ("value", "round", "pushed",
+                                            "milestone", "opt", "comp")}
+            elif kind == "evict":
+                state["evicted"] = sorted(set(state.get("evicted", []))
+                                          | {int(rec["sender"])})
+                state["num_workers"] = int(rec["num_workers"])
+            elif kind == "optimizer":
+                state["tx_config"] = (rec["name"], rec.get("kwargs", {}))
+        if state.get("tx_config"):
+            name, kwargs = state["tx_config"]
+            self._set_optimizer_locked(name, dict(kwargs))
+            self._tx_config = (name, dict(kwargs))
+        for key, rec in state["keys"].items():
+            if rec.get("value") is None:
+                continue
+            self._apply_durable_key(key, rec)
+        self._evicted = set(int(s) for s in state.get("evicted", []))
+        if state.get("num_workers") is not None:
+            self.num_workers = int(state["num_workers"])
+            self._m_workers.set(self.num_workers)
 
     # ---- networking --------------------------------------------------------
 
@@ -440,6 +653,19 @@ class GeoPSServer:
         try:
             self._serve_conn_loop(conn)
         finally:
+            # actively close: a connection dropped for a FAILED frame
+            # (CRC/length/unpicklable) must surface as a dead socket on
+            # the peer's side, or the peer waits forever on a stream
+            # this server will never read again — closing is what
+            # routes it into the client's reconnect/retry path
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
             with self._outq_lock:
                 # leave _conns FIRST so _conn_out_q can't hand a fresh
                 # queue to this dying connection after the pops below
@@ -486,12 +712,15 @@ class GeoPSServer:
     def _reply(self, conn, req: Msg, reply: Msg):
         """Echo the request id so async clients can match replies.
         ``conn=None`` (a server-internal synthesized request, e.g. a
-        best-effort DGT deadline merge) sends nothing."""
+        best-effort DGT deadline merge) sends nothing.  Every reply
+        carries the server's generation token — the restart detector
+        the client session-resume handshake stands on."""
         if conn is None:
             return
         rid = req.meta.get("rid")
         if rid is not None:
             reply.meta["rid"] = rid
+        reply.meta.setdefault("gen", self.generation)
         self._send_msg(conn, reply)
 
     def _handle(self, conn, msg: Msg) -> bool:
@@ -533,6 +762,11 @@ class GeoPSServer:
                             raise RuntimeError(
                                 f"global INIT failed for {msg.key}: "
                                 f"{e!r}")
+                    if self._durable is not None:
+                        st0 = self._store[msg.key]
+                        rec = {"k": "init", "key": msg.key}
+                        rec.update(self._key_record(msg.key, st0))
+                        self._journal(rec)
             self._reply(conn, msg, Msg(MsgType.ACK, key=msg.key))
         elif t == MsgType.PUSH:
             self._handle_push(conn, msg)
@@ -595,6 +829,9 @@ class GeoPSServer:
                     if self._tx_config != config:
                         self._set_optimizer_locked(*config)
                         self._tx_config = config
+                        self._journal({"k": "optimizer",
+                                       "name": config[0],
+                                       "kwargs": dict(config[1])})
         elif cmd == "set_gradient_compression":
             from geomx_tpu.compression import get_compressor
             self._compressor = get_compressor(msg.meta["spec"])
@@ -681,6 +918,16 @@ class GeoPSServer:
             path = self.profiler.dump()
             self._reply(conn, msg, Msg(MsgType.ACK, meta={"path": path}))
             return
+        elif cmd == "hello":
+            # session-resume handshake, step 1: who am I talking to?
+            # The generation token rides every reply already; hello
+            # exists so a RECONNECTING client can learn it before
+            # deciding whether to replay (docs/resilience.md)
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={
+                "gen": self.generation, "rank": self.rank,
+                "mode": self.mode, "num_workers": self.num_workers,
+                "durable": self._durable is not None}))
+            return
         elif cmd == "query_progress":
             # recovery state for a (re)joining worker: its per-key merged
             # round counts, so it resumes its round ids where the dead
@@ -766,7 +1013,12 @@ class GeoPSServer:
         without a python/optax dispatch per key per round); everything
         else is an optax transform.  GEOMX_NATIVE_SGD=0 opts out."""
         self._native_sgd = None
+        # durable servers take the optax path: the native kernel's
+        # state handle is not serializable, and a restart that silently
+        # re-zeroed momentum would NOT be the bit-exact resume the
+        # durable store promises
         use_native = (name in ("sgd", "momentum")
+                      and self._durable is None
                       # graftlint: disable=GXL006 — host-plane gate
                       and os.environ.get("GEOMX_NATIVE_SGD", "1") != "0")
         if use_native:
@@ -1316,6 +1568,7 @@ class GeoPSServer:
             else:
                 self._apply(key, grad)
             st.round += 1
+            self._journal_round(key, st)  # async apply = one round
             self._reply(conn, msg, Msg(MsgType.ACK, key=key))
             if self.ts_sched is not None:
                 # async intra-TS: disseminate after every apply, like the
@@ -1436,6 +1689,8 @@ class GeoPSServer:
                     "count")
             self._evicted.add(sender)
             self.num_workers -= 1
+            self._journal({"k": "evict", "sender": int(sender),
+                           "num_workers": self.num_workers})
             for key, st in list(self._store.items()):
                 pushed = st.pushed.pop(sender, 0)
                 if pushed > st.round and st.count > 0:
@@ -1457,6 +1712,10 @@ class GeoPSServer:
         """Complete a sync round: bump the round counter, answer the pulls
         it unblocks, feed the TS distributor.  Caller holds self._lock."""
         st.round += 1
+        # write-ahead: the round is durable BEFORE any pull can observe
+        # its value — a crash after a client saw round r always replays
+        # to a state that includes round r
+        self._journal_round(key, st)
         self._m_rounds.inc()
         still = []
         for c, req, need in st.waiting_pulls:
@@ -1593,6 +1852,7 @@ class GeoPSServer:
                     if reply_to[2] is not None:
                         self._seen_pushes[reply_to[2]] = True
                     st.round += 1
+                    self._journal_round(key, st)
                     if self.ts_sched is not None:
                         self._ap_queue.put((key, st.value.copy(), st.round))
             if reply_to is not None:

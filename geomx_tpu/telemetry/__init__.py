@@ -33,7 +33,10 @@ from geomx_tpu.telemetry.export import (EventLog, get_event_log, log_event,
                                         parse_prometheus_text,
                                         render_prometheus)
 from geomx_tpu.telemetry.flight import (FlightRecorder, flight_enabled,
-                                        flight_recorder_from_config)
+                                        flight_recorder_from_config,
+                                        install_incident_recorder,
+                                        notify_host_incident,
+                                        uninstall_incident_recorder)
 from geomx_tpu.telemetry.links import (LinkObservatory,
                                        get_link_observatory,
                                        reset_link_observatory)
@@ -55,4 +58,6 @@ __all__ = [
     "roofline_record", "trainer_roofline", "publish_roofline",
     "LinkObservatory", "get_link_observatory", "reset_link_observatory",
     "FlightRecorder", "flight_enabled", "flight_recorder_from_config",
+    "notify_host_incident", "install_incident_recorder",
+    "uninstall_incident_recorder",
 ]

@@ -33,6 +33,7 @@ import collections
 import json
 import math
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -86,7 +87,8 @@ class FlightRecorder:
                  exposed_jump: float = 0.25,
                  min_history: int = 5,
                  window: int = 64,
-                 decision_capacity: int = 64):
+                 decision_capacity: int = 64,
+                 incident_capacity: int = 128):
         if capacity <= 0:
             raise ValueError(f"capacity must be > 0 (got {capacity!r})")
         self.capacity = int(capacity)
@@ -105,6 +107,14 @@ class FlightRecorder:
         # step 410"
         self._decisions: "collections.deque[dict]" = collections.deque(
             maxlen=max(1, int(decision_capacity)))
+        # host-plane incidents (server/scheduler restarts, wire CRC
+        # rejections — docs/resilience.md "Host-plane recovery"): a
+        # bounded sibling ring fed by notify_host_incident, so a
+        # forensics bundle shows recovery activity next to the step
+        # records ("loss plateaued at step 812" reads differently next
+        # to "the global server restarted at generation 3")
+        self._incidents: "collections.deque[dict]" = collections.deque(
+            maxlen=max(1, int(incident_capacity)))
         self.dumps: List[str] = []    # bundle paths written so far
         self.anomalies_seen = 0
 
@@ -148,6 +158,19 @@ class FlightRecorder:
 
     def decisions(self) -> List[dict]:
         return list(self._decisions)
+
+    def record_incident(self, kind: str,
+                        detail: Optional[Dict[str, Any]] = None) -> None:
+        """Append one host-plane incident (``server_restart`` /
+        ``scheduler_restart`` / ``wire_crc_error``); it rides every
+        subsequent forensics bundle.  Usually fed through the module's
+        :func:`notify_host_incident` fan-out, not called directly."""
+        self._incidents.append({"kind": str(kind),
+                                "detail": dict(detail or {}),
+                                "unix": round(time.time(), 6)})
+
+    def incidents(self) -> List[dict]:
+        return list(self._incidents)
 
     # ---- anomaly rules (pure functions of ring + new record) ---------------
 
@@ -248,10 +271,79 @@ class FlightRecorder:
             "trigger": rec,
             "ring": self.snapshot(),
             "decisions": self.decisions(),
+            "incidents": self.incidents(),
             "capacity": self.capacity,
         }
         from geomx_tpu.utils.fileio import atomic_json_dump
         return atomic_json_dump(path, bundle)
+
+
+# ---- host-plane incident fan-out ------------------------------------------
+# The durable host plane (service/, docs/resilience.md) reports its
+# recovery activity here: one call lands the incident in (a) the
+# process-global registry counter, (b) the structured event log, and
+# (c) every installed FlightRecorder's bounded incident ring, so
+# forensics bundles show restarts and wire-CRC rejections next to the
+# step records.  Recorders self-install via install_incident_recorder
+# (the trainer does this when the flight recorder is armed).
+
+_incident_lock = threading.Lock()
+_incident_recorders: List["FlightRecorder"] = []
+
+
+def install_incident_recorder(recorder: "FlightRecorder") -> None:
+    with _incident_lock:
+        if recorder not in _incident_recorders:
+            _incident_recorders.append(recorder)
+
+
+def uninstall_incident_recorder(recorder: "FlightRecorder") -> None:
+    with _incident_lock:
+        if recorder in _incident_recorders:
+            _incident_recorders.remove(recorder)
+
+
+def announce_host_restart(node: str, generation: int, kind: str,
+                          **detail) -> None:
+    """The one restart-announcement contract both host-plane singletons
+    share: bump ``geomx_host_restarts_total{node}``, publish the
+    ``geomx_host_generation{node}`` gauge, and fan the incident out
+    (``kind`` is ``server_restart`` / ``scheduler_restart``)."""
+    try:
+        from geomx_tpu.telemetry import get_registry
+        reg = get_registry()
+        reg.counter("geomx_host_restarts_total",
+                    "Host-plane process restarts recovered from the "
+                    "durable store", ("node",)).labels(node=node).inc()
+        reg.gauge("geomx_host_generation",
+                  "Current durable generation per host-plane node",
+                  ("node",)).labels(node=node).set(generation)
+    except Exception:
+        pass
+    notify_host_incident(kind, generation=generation, **detail)
+
+
+def notify_host_incident(kind: str, **detail) -> None:
+    """Fan one host-plane incident out to the registry, the event log
+    and every installed flight recorder.  Best-effort by design: the
+    failure being reported must never be compounded by its reporting."""
+    try:
+        from geomx_tpu.telemetry import get_registry, log_event
+        get_registry().counter(
+            "geomx_host_incidents_total",
+            "Host-plane incidents (restarts recovered from the durable "
+            "store, wire integrity rejections)", ("kind",)).labels(
+            kind=kind).inc()
+        log_event(kind, **detail)
+    except Exception:
+        pass
+    with _incident_lock:
+        recorders = list(_incident_recorders)
+    for rec in recorders:
+        try:
+            rec.record_incident(kind, detail)
+        except Exception:
+            pass
 
 
 def flight_enabled(config: Optional[Any] = None) -> bool:

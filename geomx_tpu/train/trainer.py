@@ -143,8 +143,15 @@ class Trainer:
         # the registry.  Rides the probes — without telemetry there is
         # nothing to record, so that misconfig warns instead of
         # silently recording empty rings.
-        from geomx_tpu.telemetry.flight import flight_recorder_from_config
+        from geomx_tpu.telemetry.flight import (flight_recorder_from_config,
+                                                install_incident_recorder)
         self._flight = flight_recorder_from_config(self.config)
+        if self._flight is not None:
+            # host-plane incidents (server/scheduler restarts, wire-CRC
+            # rejections — notify_host_incident) land in the bounded
+            # incident ring, so forensics bundles show recovery
+            # activity next to the step records
+            install_incident_recorder(self._flight)
         self._attr_window_us = None  # trace mark of the last flight window
         if self._flight is not None and not self._telemetry:
             import warnings

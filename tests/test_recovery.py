@@ -12,6 +12,77 @@ import numpy as np
 
 from geomx_tpu.service import GeoPSClient, GeoPSServer
 
+_SERVER_CHILD = """
+import sys
+from geomx_tpu.service import GeoPSServer
+srv = GeoPSServer(num_workers=2, mode="sync", accumulate=True,
+                  port=int(sys.argv[1]), durable_dir=sys.argv[2],
+                  durable_name="g").start()
+print("READY", flush=True)
+srv.join()
+"""
+
+
+def _spawn_server(port: int, durable_dir: str):
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", _SERVER_CHILD,
+                             str(port), durable_dir],
+                            stdout=subprocess.PIPE, env=env, text=True)
+    line = proc.stdout.readline()
+    assert line.strip() == "READY", f"server child failed: {line!r}"
+    return proc
+
+
+def test_killed_server_process_resumes_mid_round(tmp_path):
+    """The real thing, not an emulation: the server runs as its OWN
+    process and is SIGKILLed mid-round (worker 0's round-2 push merged
+    in memory only).  A replacement process on the same durable dir +
+    port replays every completed round; the workers' session-resume
+    handshakes (generation token -> query_progress -> idempotent
+    re-push of the retained in-flight round) finish the round with the
+    exact aggregate — the restarted-worker dedup path of recover()/
+    client.py exercised against a process that actually died."""
+    import signal
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    proc = _spawn_server(port, str(tmp_path))
+    proc2 = None
+    ca = cb = None
+    try:
+        ca = GeoPSClient(("127.0.0.1", port), sender_id=0, reconnect=True)
+        cb = GeoPSClient(("127.0.0.1", port), sender_id=1, reconnect=True)
+        n = 48
+        for c in (ca, cb):
+            c.init("w", np.zeros(n, np.float32))
+        ca.push("w", np.full(n, 1.0, np.float32))
+        cb.push("w", np.full(n, 2.0, np.float32))
+        assert np.allclose(ca.pull("w"), 3.0)     # round 1 durable
+        ca.push("w", np.full(n, 5.0, np.float32))  # round 2 in flight
+        import time
+        time.sleep(0.3)                            # merged (memory only)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc2 = _spawn_server(port, str(tmp_path))
+        cb.push("w", np.full(n, 2.0, np.float32))  # round 2, worker 1
+        assert np.allclose(cb.pull("w", timeout=60.0), 10.0)  # 3 + 5 + 2
+        assert np.allclose(ca.pull("w", timeout=60.0), 10.0)
+        ca.stop_server()
+    finally:
+        for c in (ca, cb):
+            if c is not None:
+                c.close()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
 
 def test_worker_restart_resumes_job():
     """Kill worker 1 mid-run; a restarted incarnation re-registers,

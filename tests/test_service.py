@@ -224,9 +224,16 @@ def test_wire_header_rejects_code_loading_pickles():
     assert out.meta == m.meta and np.array_equal(out.array, m.array)
 
     # a crafted header that would import a callable must be rejected
+    # even when wrapped in a perfectly valid integrity prelude: the CRC
+    # authenticates nothing — the primitives-only unpickler is the gate
+    import zlib
+
+    from geomx_tpu.service.protocol import FRAME_VERSION
     evil = pickle.dumps({"t": 1, "k": None, "s": 0,
                          "m": {"f": np.frombuffer}}, protocol=4)
-    frame = struct.pack("<I", len(evil)) + evil
+    body = struct.pack("<I", len(evil)) + evil
+    frame = bytes((FRAME_VERSION,)) + struct.pack(
+        "<I", zlib.crc32(body)) + body
     with pytest.raises(pickle.UnpicklingError):
         Msg.decode(frame)
 
@@ -253,7 +260,13 @@ def test_tsengine_autopull_distribution():
             np.testing.assert_allclose(v0, 2.0 * rnd)
             np.testing.assert_allclose(v1, 2.0 * rnd)
 
-        # the scheduler accumulated real throughput measurements
+        # the scheduler accumulated real throughput measurements.
+        # auto_pull returns when the VALUE lands; the distributor's
+        # throughput report (which advances sched.iters) trails it on
+        # another thread — wait it out instead of racing it.
+        deadline = time.time() + 5.0
+        while server.ts_sched.iters < 3 and time.time() < deadline:
+            time.sleep(0.05)
         measured = [t for row in server.ts_sched.A for t in row
                     if t is not None]
         assert measured and all(t > 0 for t in measured)

@@ -3,10 +3,13 @@
 
 The repo carries its own measurement history — ``BENCH_r*.json``
 (driver-wrapped runs), ``BENCH_CAPTURED_r*.json`` (real hardware
-captures), ``MULTICHIP_r*.json`` (the 8-device dryrun matrix) and
+captures), ``MULTICHIP_r*.json`` (the 8-device dryrun matrix),
 ``CONTROL_r*.json`` (the ``--compare-control`` chaos-replay
 acceptance: its three boolean gates plus the controller's
-time-to-loss-target, lower is better).
+time-to-loss-target, lower is better) and ``RECOVERY_r*.json`` (the
+``--compare-recovery`` host-plane kill/restart acceptance: its
+bit-exactness/restart/corruption boolean gates plus the recovery
+stall, lower is better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
 until a human re-read the numbers.  This tool makes the trajectory a
@@ -96,6 +99,18 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
         out["rc_ok"] = (doc.get("rc") == 0)
         if not doc.get("skipped"):
             out["n_devices"] = doc.get("n_devices")
+        return out
+    if rec.get("mode") == "compare_recovery":  # RECOVERY_r*
+        for gate in ("ok", "params_bit_exact", "server_restarted",
+                     "scheduler_restarted", "recovery_stall_bounded",
+                     "scheduler_ids_stable", "scheduler_no_mass_evict",
+                     "corrupt_zero_crashes", "corrupt_crc_nonzero",
+                     "corrupt_loss_unchanged", "frame_cap_enforced"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        # recovery time is gated through the recovery_stall_bounded
+        # boolean above — the raw sub-second stall is too noisy for a
+        # relative band and would flake the gate
         return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
@@ -194,7 +209,8 @@ def compare_series(runs: List[Tuple[str, Dict[str, Any]]],
 def run(repo_dir: str, band: float = DEFAULT_BAND,
         patterns: Optional[List[str]] = None) -> dict:
     patterns = patterns or ["BENCH_CAPTURED_r*.json", "BENCH_r*.json",
-                            "MULTICHIP_r*.json", "CONTROL_r*.json"]
+                            "MULTICHIP_r*.json", "CONTROL_r*.json",
+                            "RECOVERY_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     unreadable: List[str] = []
     for pat in patterns:
