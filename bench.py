@@ -81,6 +81,22 @@ Micro-modes:
       unchanged final params; a hostile frame-length prefix is
       rejected at GEOMX_MAX_FRAME_BYTES.  Pure service plane (sockets
       + numpy) — no jax mesh, CPU, seconds.
+  bench.py --compare-manyparty [--steps=10] [--parties=16] [--shards=4]
+           [--dim=1024] [--keys=8] [--seed=991]
+           [--schedule="seed=991;kill@3:node=shard1,restart_after=2;..."]
+      One JSON line for the many-party sharded global tier
+      (docs/resilience.md "Many-party global tier"): 16+ virtual
+      parties (session-resume-armed ShardedGlobalClients pushing
+      P3-chunked gradients) against a key-range sharded tier of N
+      durable GeoPSServers under a shard-targeted chaos schedule —
+      one shard kill+restart in place, one shard failover onto a NEW
+      port (journal replay + scheduler map bump), a seeded corrupt@
+      epoch and a throttle@ epoch — finishing params BIT-EXACT vs an
+      uninterrupted same-seed baseline with zero lost rounds and a
+      bounded stall; plus a scheduler-driven load rebalance on a live
+      tier (exact-once merges across the key migration) and a merge-
+      throughput curve over shard count that must scale.  Pure
+      service plane (sockets + numpy) — no jax mesh, CPU.
   bench.py --audit [--model=mlp]
       One JSON line for the Graft Auditor (geomx_tpu/analysis/,
       docs/analysis.md): every green tier-1 step program (vanilla, bsc,
@@ -3846,6 +3862,581 @@ def compare_recovery_main(argv):
     _emit(_compare_recovery(**kwargs))
 
 
+# --------------------------------------------------------------------------
+# --compare-manyparty: 16+ virtual parties against a key-range SHARDED
+# global tier (scheduler-owned map) under shard-targeted chaos — finish
+# bit-exact vs an uninterrupted same-seed baseline, with merge
+# throughput scaling over shard count (docs/resilience.md "Many-party
+# global tier")
+# --------------------------------------------------------------------------
+
+
+class _ManyPartyCluster:
+    """Scheduler + N durable GeoPSServer shards (key-range map v1) +
+    P virtual parties, each a session-resume-armed ShardedGlobalClient
+    pushing P3-chunked gradients.  The chaos ``kill@...node=shard<i>``
+    verbs drive :meth:`lifecycle`: kill = ``crash()``; restart = a
+    replacement on the same durable journal — same port for most
+    shards, but ``failover_shard`` restarts on a NEW port plus a
+    scheduler ``shard_failover`` map bump (the missed-restart-window
+    path: journal replayed into a replacement, clients redirected)."""
+
+    def __init__(self, base_dir: str, parties: int, shards: int, keys,
+                 dim: int, failover_shard=None, grace_s: float = 30.0,
+                 p3: bool = True):
+        import numpy as np
+
+        from geomx_tpu.service import (GeoScheduler, ShardedGlobalClient,
+                                       start_sharded_global_tier)
+        from geomx_tpu.service.server import GeoPSServer
+        from geomx_tpu.service.shardmap import even_bounds
+        self.np = np
+        self.parties, self.num_shards = parties, shards
+        self.keys, self.dim = list(keys), dim
+        self.failover_shard = failover_shard
+        self._GeoPSServer = GeoPSServer
+        self.tier_dir = os.path.join(base_dir, "tier")
+        self.bounds = even_bounds(shards)
+        self.scheduler = GeoScheduler(
+            durable_dir=os.path.join(base_dir, "scheduler"),
+            restart_grace_s=grace_s).start()
+        self.sched_addr = ("127.0.0.1", self.scheduler.port)
+        self.shards = start_sharded_global_tier(
+            self.sched_addr, num_shards=shards, num_workers=parties,
+            durable_dir=self.tier_dir)
+        self.ports = [s.port for s in self.shards]
+        self.workers = [
+            ShardedGlobalClient(self.sched_addr, sender_id=p,
+                                reconnect=True,
+                                p3_slice_elems=(max(8, dim // 2)
+                                                if p3 else None),
+                                reconnect_timeout_s=8.0,
+                                op_timeout_s=240.0)
+            for p in range(parties)]
+        for key in self.keys:
+            for w in self.workers:   # idempotent replays of one INIT
+                w.init(key, np.zeros(dim, np.float32))
+        self.restarts = {}
+        self.kill_t = {}
+        self.outage_s = 0.0
+        self.killed = set()
+        self.failovers = 0
+
+    def lifecycle(self, action: str, node: str) -> None:
+        from geomx_tpu.resilience.chaos import shard_node_index
+        from geomx_tpu.service import SchedulerClient
+        i = shard_node_index(node)
+        if i is None or not 0 <= i < self.num_shards:
+            raise ValueError(f"manyparty chaos targets shard<i> "
+                             f"(got {node!r})")
+        now = time.monotonic()
+        if action == "kill":
+            self.kill_t[node] = now
+            self.shards[i].crash()
+            self.killed.add(node)
+            return
+        failover = (i == self.failover_shard)
+        # restart = a replacement server replaying shard<i>'s journal;
+        # the failover path binds a NEW port and re-points the map
+        repl = self._GeoPSServer(
+            num_workers=self.parties, mode="sync", accumulate=True,
+            rank=i, shard_index=i,
+            port=0 if failover else self.ports[i],
+            shard_range=(self.bounds[i], self.bounds[i + 1]),
+            shard_map_version=1, durable_dir=self.tier_dir,
+            durable_name=f"shard{i}").start()
+        self.shards[i] = repl
+        if failover:
+            self.ports[i] = repl.port
+            sc = SchedulerClient(self.sched_addr)
+            try:
+                sc.shard_failover(i, "127.0.0.1", repl.port)
+            finally:
+                sc.close()
+            self.failovers += 1
+        self.restarts[node] = self.restarts.get(node, 0) + 1
+        self.killed.discard(node)
+        self.outage_s += now - self.kill_t.pop(node, now)
+
+    def map_version(self) -> int:
+        from geomx_tpu.service import SchedulerClient
+        sc = SchedulerClient(self.sched_addr)
+        try:
+            m = sc.shard_map()
+            return 0 if m is None else int(m["version"])
+        finally:
+            sc.close()
+
+    def close(self) -> None:
+        for w in self.workers:
+            try:
+                w.close()
+            except Exception:
+                pass
+        for s in self.shards:
+            try:
+                s.stop(forward=False)
+            except Exception:
+                pass
+        try:
+            self.scheduler.stop()
+        except Exception:
+            pass
+
+
+def _manyparty_train(base_dir: str, steps: int, parties: int,
+                     shards: int, keys, dim: int, schedule=None,
+                     seed: int = 991, failover_shard=None,
+                     stall_dwell_s: float = 0.4):
+    """One seeded many-party run on the sharded tier; the same
+    lock-step chaos clock as ``_recovery_train`` (kill@s always lands
+    before step-s traffic; outages cannot be batched away by machine
+    speed).  Returns final params, per-worker progress, wall/outage
+    times and restart stats."""
+    import numpy as np
+
+    from geomx_tpu.resilience.chaos import (ChaosEngine,
+                                            set_node_lifecycle_hook)
+    from geomx_tpu.service.protocol import shaping_extra_seconds
+    cluster = _ManyPartyCluster(base_dir, parties, shards, keys, dim,
+                                failover_shard=failover_shard)
+    targets = {p: {key: np.full(dim, (p % 7 + 1) * (k_i + 1) * 0.5,
+                                np.float32)
+                   for k_i, key in enumerate(keys)}
+               for p in range(parties)}
+    progress = [0] * parties
+    errors = []
+    losses = [[] for _ in range(parties)]
+    cond = threading.Condition()
+    allowed = [0]
+
+    def worker_loop(p):
+        rng = np.random.default_rng(seed + p)
+        w = cluster.workers[p]
+        try:
+            for step in range(steps):
+                with cond:
+                    while step >= allowed[0]:
+                        cond.wait(0.5)
+                t0 = time.monotonic()
+                step_loss = 0.0
+                for key in keys:
+                    val = w.pull(key, timeout=200.0)
+                    g = (val - targets[p][key]) * 0.1 \
+                        + rng.normal(0.0, 0.01, dim).astype(np.float32)
+                    w.push(key, (-0.05 * g).astype(np.float32))
+                    step_loss += float(np.mean(
+                        (val - targets[p][key]) ** 2))
+                # chaos throttle@/delay@: this party's WAN link is
+                # shaped — realize the injected degradation as real
+                # wall-clock, bounded so the bench stays finite
+                extra = shaping_extra_seconds(
+                    p, time.monotonic() - t0)
+                if extra > 0:
+                    time.sleep(min(extra, 2.0))
+                losses[p].append(step_loss / len(keys))
+                progress[p] = step + 1
+        except Exception as e:   # surfaced in the record, fails the gate
+            errors.append(f"party {p}: {e!r}")
+
+    threads = [threading.Thread(target=worker_loop, args=(p,),
+                                daemon=True) for p in range(parties)]
+    t0 = time.monotonic()
+    engine = None
+    if schedule is not None:
+        engine = ChaosEngine(schedule, controller=None)
+        set_node_lifecycle_hook(cluster.lifecycle)
+    try:
+        for t in threads:
+            t.start()
+        for s in range(steps):
+            if engine is not None:
+                engine.tick(s)
+            with cond:
+                allowed[0] = s + 1
+                cond.notify_all()
+            stall_t = time.monotonic()
+            last = min(progress)
+            while min(progress) <= s:
+                if errors or not any(t.is_alive() for t in threads):
+                    break
+                if min(progress) > last:
+                    last, stall_t = min(progress), time.monotonic()
+                if cluster.killed and \
+                        time.monotonic() - stall_t > stall_dwell_s:
+                    break   # outage: keep the logical clock moving so
+                    # the paired restart@ can fire
+                time.sleep(0.02)
+            if errors:
+                break
+        with cond:
+            allowed[0] = steps
+            cond.notify_all()
+        for t in threads:
+            t.join(timeout=600.0)
+        wall_s = time.monotonic() - t0
+        final, prog = {}, []
+        if not errors:
+            final = {key: np.asarray(
+                cluster.workers[0].pull(key, timeout=120.0))
+                for key in keys}
+            prog = [cluster.workers[p].progress()
+                    for p in range(parties)]
+        return {"final": final, "losses": losses, "wall_s": wall_s,
+                "errors": errors, "restarts": dict(cluster.restarts),
+                "outage_s": cluster.outage_s,
+                "failovers": cluster.failovers,
+                "map_version": cluster.map_version() if not errors
+                else None,
+                "progress": prog}
+    finally:
+        if engine is not None:
+            engine.close()
+            set_node_lifecycle_hook(None)
+        cluster.close()
+
+
+# one shard of the key-range tier as its OWN process: shard-count
+# scaling must measure real parallelism, and threads sharing one
+# interpreter would share one GIL for the decode/reply halves of every
+# merge — subprocesses are the production shape anyway
+_MANYPARTY_SHARD_CHILD = """
+import sys
+from geomx_tpu.service.server import GeoPSServer
+from geomx_tpu.service.shardmap import even_bounds
+total, idx = map(int, sys.argv[1:3])
+b = even_bounds(total)
+srv = GeoPSServer(num_workers=1, mode="async", accumulate=True, rank=idx,
+                  shard_index=idx, shard_range=(b[idx], b[idx+1]),
+                  shard_map_version=1).start()
+print("PORT", srv.port, flush=True)
+srv.join()
+"""
+
+
+def _manyparty_throughput(shard_counts, nkeys: int = 8,
+                          dim: int = 65536, pushes_per_key: int = 48,
+                          threads: int = 4, repeats: int = 2):
+    """Global-tier merge throughput vs shard count.  Each shard runs as
+    its OWN subprocess (threads in one interpreter would share a GIL
+    and hide the scaling); the parent blasts pre-encoded async PUSH
+    frames through a bounded pipeline window and counts merged ACKs —
+    the merge path itself (decode + sender-ordered accumulate + reply),
+    no sync-gate coordination in the measurement.  One shard serializes
+    every merge behind a single process/lock; key-range sharding splits
+    the work across processes, so the rate must grow with shard count.
+    Returns per-count {shards, wall_s, pushes_per_s} (best of
+    ``repeats``)."""
+    import bisect
+    import socket as _socket
+    import subprocess
+
+    import numpy as np
+
+    from geomx_tpu.service.protocol import (Msg, MsgType, recv_frame,
+                                            send_frame)
+    from geomx_tpu.service.shardmap import even_bounds, key_hash
+    keys = [f"t{i}" for i in range(nkeys)]
+
+    def run_once(S):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs, ports = [], []
+        try:
+            for i in range(S):
+                p = subprocess.Popen(
+                    [sys.executable, "-c", _MANYPARTY_SHARD_CHILD,
+                     str(S), str(i)],
+                    stdout=subprocess.PIPE, env=env, text=True)
+                line = p.stdout.readline()
+                if not line.startswith("PORT"):
+                    raise RuntimeError(
+                        f"shard child failed to start: {line!r}")
+                ports.append(int(line.split()[1]))
+                procs.append(p)
+            bounds = even_bounds(S)
+            owner = {k: bisect.bisect_right(bounds, key_hash(k)) - 1
+                     for k in keys}
+            for k in keys:   # one INIT per key at its owner
+                s = _socket.create_connection(("127.0.0.1",
+                                               ports[owner[k]]))
+                m = Msg(MsgType.INIT, key=k,
+                        array=np.zeros(dim, np.float32))
+                m.meta["rid"] = 1
+                send_frame(s, m)
+                recv_frame(s)
+                s.close()
+            groups = [[k for j, k in enumerate(keys)
+                       if j % threads == t] for t in range(threads)]
+            errs = []
+
+            def blast(t):
+                try:
+                    conns, frames = {}, {}
+                    for k in groups[t]:
+                        o = owner[k]
+                        if o not in conns:
+                            conns[o] = _socket.create_connection(
+                                ("127.0.0.1", ports[o]))
+                        msg = Msg(MsgType.PUSH, key=k,
+                                  array=np.full(dim, 1.0, np.float32))
+                        msg.sender = t
+                        msg.meta["rid"] = 7
+                        frames[k] = msg.encode()
+                    window, inflight = 16, []
+                    for _i in range(pushes_per_key):
+                        for k in groups[t]:
+                            c = conns[owner[k]]
+                            f = frames[k]
+                            c.sendall(len(f).to_bytes(4, "little") + f)
+                            inflight.append(c)
+                            if len(inflight) >= window:
+                                recv_frame(inflight.pop(0))
+                    for c in inflight:
+                        recv_frame(c)
+                    for c in conns.values():
+                        c.close()
+                except Exception as e:
+                    errs.append(repr(e))
+
+            ths = [threading.Thread(target=blast, args=(t,),
+                                    daemon=True)
+                   for t in range(threads)]
+            t0 = time.monotonic()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=600.0)
+            wall = time.monotonic() - t0
+            if errs:
+                raise RuntimeError(f"throughput blast failed: {errs}")
+            return wall
+        finally:
+            for p in procs:
+                p.kill()
+                p.wait(timeout=10)
+
+    out = []
+    for S in shard_counts:
+        best = None
+        for _rep in range(repeats):
+            wall = run_once(S)
+            rate = pushes_per_key * nkeys / max(wall, 1e-9)
+            if best is None or rate > best["pushes_per_s"]:
+                best = {"shards": S, "wall_s": round(wall, 3),
+                        "pushes_per_s": round(rate, 1)}
+        out.append(best)
+    return out
+
+
+def _manyparty_rebalance_probe(dim: int = 64) -> dict:
+    """Scheduler-driven rebalance on a live 2-shard tier under skewed
+    load: boundaries move toward the observed per-key push counts, the
+    hot keys' state migrates (rounds, per-sender counts), the map
+    version bumps, and post-rebalance traffic merges exactly once."""
+    import numpy as np
+
+    from geomx_tpu.service import (GeoScheduler, SchedulerClient,
+                                   ShardedGlobalClient,
+                                   start_sharded_global_tier)
+    from geomx_tpu.service.shardmap import ShardMap
+    sched = GeoScheduler().start()
+    servers = start_sharded_global_tier(("127.0.0.1", sched.port),
+                                        num_shards=2, num_workers=2)
+    ws = [ShardedGlobalClient(("127.0.0.1", sched.port), sender_id=p,
+                              reconnect=True) for p in range(2)]
+    sc = SchedulerClient(("127.0.0.1", sched.port))
+    try:
+        m = ShardMap.from_meta(sc.shard_map())
+        hot = [f"h{i}" for i in range(64)
+               if m.shard_for(f"h{i}") == 0][:6]
+        cold = [f"c{i}" for i in range(64)
+                if m.shard_for(f"c{i}") == 1][:2]
+        for key in hot + cold:
+            for w in ws:
+                w.init(key, np.zeros(dim, np.float32))
+        for _r in range(3):
+            for key in hot:
+                for w in ws:
+                    w.push(key, np.ones(dim, np.float32))
+                for w in ws:
+                    w.pull(key)
+        for key in cold:
+            for w in ws:
+                w.push(key, np.ones(dim, np.float32))
+            for w in ws:
+                w.pull(key)
+        res = sc.rebalance_shards(min_gain=0.05)
+        m2 = ShardMap.from_meta(res["map"])
+        moved = [k for k in hot if m2.shard_for(k) != 0]
+        post_exact = True
+        for key in hot:
+            for w in ws:
+                w.push(key, np.ones(dim, np.float32))
+            got = ws[0].pull(key, timeout=60.0)
+            post_exact &= bool(np.allclose(got, 8.0))  # 4 rounds x 2
+        prog = ws[0].progress()
+        return {"changed": bool(res["changed"]),
+                "moved_keys": int(res["moved_keys"]),
+                "map_version": int(res["map"]["version"]),
+                "keys_rerouted": len(moved),
+                "rounds_preserved": all(prog[k] == 4 for k in hot),
+                "post_rebalance_exact": post_exact,
+                "ok": bool(res["changed"] and res["moved_keys"] > 0
+                           and moved and post_exact
+                           and all(prog[k] == 4 for k in hot))}
+    finally:
+        sc.close()
+        for w in ws:
+            w.close()
+        for srv in servers:
+            try:
+                srv.stop(forward=False)
+            except Exception:
+                pass
+        sched.stop()
+
+
+def _compare_manyparty(steps: int = 10, parties: int = 16,
+                       shards: int = 4, dim: int = 1024,
+                       nkeys: int = 8, schedule_spec: str = None,
+                       seed: int = 991, throughput_dim: int = 65536):
+    """The many-party acceptance (docs/resilience.md "Many-party
+    global tier"):
+
+    1. BASELINE — ``parties`` virtual parties x ``shards`` key-range
+       shards, uninterrupted; P3-chunked pushes, session resume armed.
+    2. CHAOS — same seeds under a shard-targeted schedule: one shard
+       kill+restart in place, one shard kill whose restart FAILS OVER
+       to a new port (journal replay + scheduler map bump), a seeded
+       corrupt@ epoch and a throttle@ epoch.  Must finish params
+       BIT-EXACT vs baseline with zero lost rounds and a bounded
+       stall.
+    3. REBALANCE — scheduler-driven boundary move from observed load
+       on a live tier, exact-once merges across the migration.
+    4. THROUGHPUT — the same traffic against 1..N shards: merge
+       throughput must scale with shard count.
+    """
+    import numpy as np
+
+    from geomx_tpu.resilience.chaos import ChaosSchedule
+    from geomx_tpu.service.protocol import wire_crc_errors
+    if shards < 2:
+        raise SystemExit("--compare-manyparty needs --shards >= 2")
+    if schedule_spec is None:
+        schedule_spec = (
+            f"seed={seed};"
+            "corrupt@2:party=3,rate=30,steps=5;"
+            "kill@3:node=shard1,restart_after=2;"
+            "throttle@4:party=2,factor=0.4,steps=3;"
+            f"kill@6:node=shard{shards - 1},restart_after=2")
+    schedule = ChaosSchedule.from_spec(schedule_spec)
+    keys = [f"w{i}" for i in range(nkeys)]
+    rec = {"mode": "compare_manyparty", "steps": steps,
+           "parties": parties, "shards": shards, "dim": dim,
+           "keys": keys, "schedule": schedule.spec(), "seed": seed}
+
+    with tempfile.TemporaryDirectory(prefix="geomx_manyparty_") as td:
+        base = _manyparty_train(os.path.join(td, "baseline"), steps,
+                                parties, shards, keys, dim,
+                                schedule=None, seed=seed)
+        crc_before = wire_crc_errors()
+        reco = _manyparty_train(os.path.join(td, "chaos"), steps,
+                                parties, shards, keys, dim,
+                                schedule=schedule, seed=seed,
+                                failover_shard=shards - 1)
+        crc_errors = wire_crc_errors() - crc_before
+
+    def digest(final):
+        import hashlib
+        h = hashlib.sha256()
+        for key in keys:
+            h.update(np.ascontiguousarray(final[key]).tobytes())
+        return h.hexdigest()
+
+    def bit_exact(a, b):
+        return bool(a and b and all(
+            np.array_equal(a[key], b[key]) for key in keys))
+
+    stall_s = max(0.0, reco["wall_s"] - base["wall_s"])
+    zero_lost = bool(reco["progress"] and all(
+        prog.get(key, 0) == steps
+        for prog in reco["progress"] for key in keys))
+    rec["baseline"] = {"wall_s": round(base["wall_s"], 3),
+                       "errors": base["errors"],
+                       "params_digest": digest(base["final"])
+                       if base["final"] else None}
+    rec["chaos"] = {"wall_s": round(reco["wall_s"], 3),
+                    "errors": reco["errors"],
+                    "restarts": reco["restarts"],
+                    "outage_s": round(reco["outage_s"], 3),
+                    "failovers": reco["failovers"],
+                    "map_version": reco["map_version"],
+                    "crc_errors": crc_errors,
+                    "params_digest": digest(reco["final"])
+                    if reco["final"] else None}
+    rec["rebalance"] = _manyparty_rebalance_probe()
+    shard_counts = sorted({1, 2, shards} - {0})
+    shard_counts = [s for s in shard_counts if s <= shards]
+    rec["throughput"] = {"dim": throughput_dim,
+                         "curve": _manyparty_throughput(
+                             shard_counts, nkeys=nkeys,
+                             dim=throughput_dim)}
+    curve = rec["throughput"]["curve"]
+    base_thr = curve[0]["pushes_per_s"]
+    peak_thr = curve[-1]["pushes_per_s"]
+    rec["throughput"]["scaling"] = round(peak_thr / max(base_thr, 1e-9),
+                                         3)
+
+    # ---- acceptance gates (benchtrend + manyparty-smoke CI) ----------
+    rec["params_bit_exact"] = bit_exact(base["final"], reco["final"])
+    rec["zero_lost_rounds"] = zero_lost
+    rec["shard_restarted"] = sum(reco["restarts"].values()) >= 2
+    rec["failover_performed"] = reco["failovers"] >= 1
+    rec["map_version_bumped"] = bool(
+        reco["map_version"] and reco["map_version"] > 1)
+    rec["corrupt_crc_nonzero"] = crc_errors > 0
+    rec["stall_s"] = round(stall_s, 3)
+    rec["stall_bounded"] = bool(
+        stall_s <= reco["outage_s"] + 30.0)
+    rec["rebalance_applied"] = bool(rec["rebalance"]["ok"])
+    rec["throughput_scales"] = bool(
+        rec["throughput"]["scaling"] >= 1.15)
+    rec["ok"] = bool(
+        not base["errors"] and not reco["errors"]
+        and rec["params_bit_exact"] and rec["zero_lost_rounds"]
+        and rec["shard_restarted"] and rec["failover_performed"]
+        and rec["map_version_bumped"] and rec["corrupt_crc_nonzero"]
+        and rec["stall_bounded"] and rec["rebalance_applied"]
+        and rec["throughput_scales"])
+    return rec
+
+
+def compare_manyparty_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--steps="):
+            kwargs["steps"] = int(a.split("=", 1)[1])
+        elif a.startswith("--parties="):
+            kwargs["parties"] = int(a.split("=", 1)[1])
+        elif a.startswith("--shards="):
+            kwargs["shards"] = int(a.split("=", 1)[1])
+        elif a.startswith("--dim="):
+            kwargs["dim"] = int(a.split("=", 1)[1])
+        elif a.startswith("--keys="):
+            kwargs["nkeys"] = int(a.split("=", 1)[1])
+        elif a.startswith("--schedule="):
+            kwargs["schedule_spec"] = a.split("=", 1)[1]
+        elif a.startswith("--seed="):
+            kwargs["seed"] = int(a.split("=", 1)[1])
+        elif a.startswith("--throughput-dim="):
+            kwargs["throughput_dim"] = int(a.split("=", 1)[1])
+    if "shards" not in kwargs:
+        from geomx_tpu.service.sharded import default_num_shards
+        env_default = default_num_shards()
+        kwargs["shards"] = env_default if env_default > 1 else 4
+    _emit(_compare_manyparty(**kwargs))
+
+
 def main():
     if "--compare-kernels" in sys.argv:
         # kernel micro-mode: in-process, single device is enough (no
@@ -3901,6 +4492,10 @@ def main():
         # host-plane recovery acceptance: pure service-plane (sockets +
         # numpy), no jax mesh — runs anywhere in seconds
         compare_recovery_main(sys.argv[1:])
+    elif "--compare-manyparty" in sys.argv:
+        # many-party sharded-global-tier acceptance: pure service-plane
+        # (sockets + numpy, 16+ worker threads), no jax mesh
+        compare_manyparty_main(sys.argv[1:])
     elif "--compare-resilience" in sys.argv:
         # chaos/structure micro-mode like --compare-pipeline: in-process
         # on the CPU backend with a 2-device virtual mesh

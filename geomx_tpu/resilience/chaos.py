@@ -36,13 +36,18 @@ Spec format (semicolon-separated events; see docs/resilience.md):
                                             link-quality shaping: m ms
                                             of added latency per WAN
                                             round on party p's link
-    kill@<step>:node=server|scheduler[,restart_after=<n>]
+    kill@<step>:node=server|scheduler|shard<i>[,restart_after=<n>]
                                             host-plane process death:
                                             drives the installed node
                                             lifecycle hook; with
                                             restart_after, the paired
-                                            restart@ fires n steps later
-    restart@<step>:node=server|scheduler    explicit restart
+                                            restart@ fires n steps
+                                            later.  ``shard<i>``
+                                            targets ONE shard of the
+                                            key-range sharded global
+                                            tier — the rest of the
+                                            tier keeps merging
+    restart@<step>:node=server|scheduler|shard<i>    explicit restart
     corrupt@<step>:party=<p>,rate=<r>[,steps=<n>]
                                             bit-corruption epoch: r% of
                                             party p's retry-protected
@@ -74,6 +79,7 @@ from __future__ import annotations
 
 import dataclasses
 import random as _random
+import re
 from typing import Iterable, List, Optional, Tuple
 
 # event kinds after duration expansion (a blackout/flap/drop/throttle/
@@ -83,8 +89,21 @@ _KINDS = ("blackout", "readmit", "drop_rate", "drop_clear",
           "throttle", "throttle_clear", "delay", "delay_clear",
           "kill", "restart", "corrupt", "corrupt_clear")
 
-# kill/restart targets: the host plane's two central singletons
+# kill/restart targets: the host plane's central singletons, plus
+# "shard<i>" for one shard of the key-range sharded global tier
 _NODES = ("server", "scheduler")
+
+_SHARD_NODE = re.compile(r"^shard(\d+)$")
+
+
+def _valid_node(node: str) -> bool:
+    return node in _NODES or bool(_SHARD_NODE.match(node))
+
+
+def shard_node_index(node: str) -> "Optional[int]":
+    """``"shard3" -> 3``; None for the non-shard targets."""
+    m = _SHARD_NODE.match(node)
+    return int(m.group(1)) if m else None
 
 # host-plane lifecycle hook (``kill@``/``restart@``): the in-process
 # counterpart of protocol.set_drop_rate_override — whoever owns the
@@ -117,10 +136,10 @@ class ChaosEvent:
                              f"valid: {_KINDS}")
         if self.step < 0:
             raise ValueError(f"chaos event step must be >= 0 ({self.step})")
-        if self.kind in ("kill", "restart") and self.node not in _NODES:
+        if self.kind in ("kill", "restart") and not _valid_node(self.node):
             raise ValueError(
-                f"chaos {self.kind} targets node= one of {_NODES} "
-                f"(got {self.node!r})")
+                f"chaos {self.kind} targets node= one of {_NODES} or "
+                f"shard<i> (got {self.node!r})")
 
 
 class ChaosSchedule:
@@ -301,13 +320,38 @@ class ChaosSchedule:
                blackouts: int = 1, blackout_len: Tuple[int, int] = (2, 5),
                drop_epochs: int = 0,
                drop_rate: Tuple[int, int] = (10, 50),
-               keep_party: int = 0) -> "ChaosSchedule":
+               keep_party: int = 0,
+               node_kills: int = 0,
+               nodes: Tuple[str, ...] = ("server",),
+               kill_restart_after: Tuple[int, int] = (1, 3),
+               corrupt_epochs: int = 0,
+               corrupt_rate: Tuple[int, int] = (20, 40),
+               throttle_epochs: int = 0,
+               throttle_factor: Tuple[float, float] = (0.1, 0.5)
+               ) -> "ChaosSchedule":
         """Sample a reproducible schedule: ``blackouts`` party outages
         (never ``keep_party`` — someone must survive) and ``drop_epochs``
         loss windows, all from ``random.Random(seed)`` so the same
-        arguments always produce the same scenario."""
+        arguments always produce the same scenario.
+
+        Multi-node scenarios (the 16+ party chaos fleet): ``node_kills``
+        kill+restart pairs sampled over ``nodes`` (e.g.
+        ``("shard0", "shard1", "scheduler")`` — each kill picks a node,
+        a start step, and a restart ``kill_restart_after`` steps later;
+        at most one outstanding kill per node at a time, and a pair
+        whose restart would land past the run is dropped
+        (``node_kills`` is an upper bound), so a schedule never
+        restarts a node that is not down and never leaves one
+        permanently dead.  ``corrupt_epochs`` /
+        ``throttle_epochs`` sample seeded bit-flip and link-shaping
+        windows over non-kept parties."""
         if num_parties < 2 and blackouts:
             raise ValueError("party blackouts need num_parties >= 2")
+        for n in nodes:
+            if not _valid_node(n):
+                raise ValueError(
+                    f"random: node {n!r} is not one of {_NODES} or "
+                    "shard<i>")
         rng = _random.Random(seed)
         events: List[ChaosEvent] = []
         candidates = [p for p in range(num_parties) if p != keep_party]
@@ -323,6 +367,41 @@ class ChaosSchedule:
             events.append(ChaosEvent(start, "drop_rate",
                                      rate=rng.randint(*drop_rate)))
             events.append(ChaosEvent(start + length, "drop_clear"))
+        down_until: dict = {}   # node -> step its restart fires
+        for _ in range(node_kills):
+            node = rng.choice(list(nodes))
+            gap = rng.randint(*kill_restart_after)
+            start = rng.randint(1, max(1, steps - gap - 1))
+            if start <= down_until.get(node, 0):
+                # this node is still down at the sampled step: push the
+                # kill past its pending restart (never a double-kill)
+                start = down_until[node] + 1
+            if start + gap >= steps:
+                # the pair no longer fits the run: a kill whose restart
+                # cannot fire would leave the node permanently dead and
+                # make the schedule unsatisfiable — drop it (node_kills
+                # is an upper bound)
+                continue
+            events.append(ChaosEvent(start, "kill", node=node))
+            events.append(ChaosEvent(start + gap, "restart", node=node))
+            down_until[node] = start + gap
+        for _ in range(corrupt_epochs):
+            start = rng.randint(1, max(1, steps - 2))
+            length = rng.randint(1, max(1, steps - start - 1))
+            party = rng.choice(candidates) if candidates else -1
+            events.append(ChaosEvent(start, "corrupt", party=party,
+                                     rate=rng.randint(*corrupt_rate)))
+            events.append(ChaosEvent(start + length, "corrupt_clear",
+                                     party=party))
+        for _ in range(throttle_epochs):
+            start = rng.randint(1, max(1, steps - 2))
+            length = rng.randint(1, max(1, steps - start - 1))
+            party = rng.choice(candidates) if candidates else -1
+            factor = round(rng.uniform(*throttle_factor), 3)
+            events.append(ChaosEvent(start, "throttle", party=party,
+                                     factor=factor))
+            events.append(ChaosEvent(start + length, "throttle_clear",
+                                     party=party))
         return cls(events, seed=seed)
 
 

@@ -11,10 +11,15 @@ pulls flow back down — over TCP with length-prefixed frames, priority
 send queues (P3), per-hop compression, and heartbeat liveness.
 """
 
-from geomx_tpu.service.client import GeoPSClient
+from geomx_tpu.service.client import GeoPSClient, WrongShardError
 from geomx_tpu.service.protocol import Msg, MsgType
 from geomx_tpu.service.scheduler import GeoScheduler, SchedulerClient
 from geomx_tpu.service.server import GeoPSServer
+from geomx_tpu.service.sharded import (ShardedGlobalClient,
+                                       start_sharded_global_tier)
+from geomx_tpu.service.shardmap import ShardMap
 
 __all__ = ["Msg", "MsgType", "GeoPSServer", "GeoPSClient",
-           "GeoScheduler", "SchedulerClient"]
+           "GeoScheduler", "SchedulerClient", "ShardMap",
+           "ShardedGlobalClient", "WrongShardError",
+           "start_sharded_global_tier"]

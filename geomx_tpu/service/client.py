@@ -46,6 +46,18 @@ class _RelayConnectError(OSError):
     the partial may safely go elsewhere."""
 
 
+class WrongShardError(RuntimeError):
+    """A key-range sharded server refused a request for a key outside
+    its owned range (docs/resilience.md "Many-party global tier"): the
+    client's shard map is stale.  Carries the server's map version so
+    the caller can fetch a map at least that fresh and re-route —
+    a redirect, never a wrong-shard merge."""
+
+    def __init__(self, message: str, map_version: int = 0):
+        super().__init__(message)
+        self.map_version = int(map_version)
+
+
 class _Pending:
     __slots__ = ("event", "reply", "frame", "priority", "parts")
 
@@ -63,7 +75,8 @@ class GeoPSClient:
                  auto_pull: bool = False,
                  p3_slice_elems: Optional[int] = None,
                  ts_node: Optional[int] = None,
-                 reconnect: Optional[bool] = None):
+                 reconnect: Optional[bool] = None,
+                 reconnect_timeout_s: Optional[float] = None):
         """``auto_pull=True`` registers this client for server-initiated
         updates (the TSEngine AutoPull path): after each aggregation round
         the server pushes fresh values in throughput-scheduled order, and
@@ -90,7 +103,8 @@ class GeoPSClient:
             reconnect = bool(env_int(("GEOMX_RECONNECT",), 0))
         self._reconnect = bool(reconnect)
         self._reconnect_timeout_s = float(env_int(
-            ("GEOMX_RECONNECT_TIMEOUT_S",), 30))
+            ("GEOMX_RECONNECT_TIMEOUT_S",), 30)) \
+            if reconnect_timeout_s is None else float(reconnect_timeout_s)
         if self._reconnect and resend_timeout_ms is None and not env_int(
                 ("GEOMX_RESEND", "PS_RESEND"), 0):
             # reconnect without resend could double-merge a replayed
@@ -107,10 +121,23 @@ class GeoPSClient:
         # last server generation token seen in any reply — the restart
         # detector of the session-resume handshake
         self._server_gen: Optional[int] = None
-        # key -> (round, clean frame, priority): the most recent push
-        # per key, retained (reconnect mode only) so a round the dead
-        # server incarnation lost can be re-pushed verbatim
+        # key -> (round, [clean frames], priority): the most recent push
+        # per key — ONE whole-tensor frame, or the round's full P3 chunk
+        # set — retained (reconnect mode only) so a round the dead
+        # server incarnation lost can be re-pushed verbatim.  Released
+        # when the round's pull reply is consumed (the server journals
+        # write-ahead of pull replies, so a reply proves durability);
+        # total retained bytes ride geomx_resend_buffer_bytes.
         self._last_push: Dict[str, tuple] = {}
+        self._resend_buffer_bytes = 0
+        # retain runs on caller threads, release on the recv loop:
+        # the byte accounting must not double-subtract a racing entry
+        self._buf_lock = threading.Lock()
+        from geomx_tpu.telemetry import get_registry
+        self._m_resend_buf = get_registry().gauge(
+            "geomx_resend_buffer_bytes",
+            "Bytes of retained session-resume re-push frames",
+            ("sender",)).labels(str(sender_id))
         self._registered_autopull = bool(auto_pull)
         self._autopull: Dict[str, Any] = {}
         self._apevents: Dict[str, threading.Event] = {}
@@ -136,17 +163,11 @@ class GeoPSClient:
         self.p3_slice_elems = p3_slice_elems
         self._slicer = None
         if p3_slice_elems:
-            if self._reconnect:
-                # session resume retains ONE whole-tensor frame per key
-                # for the in-flight re-push; a P3-chunked push has no
-                # such frame, so a restarted server's lost round would
-                # wedge silently — refuse the combination loudly until
-                # chunk-set retention exists
-                raise ValueError(
-                    "GEOMX_RECONNECT does not compose with P3 push "
-                    "chunking (GEOMX_ENABLE_P3 / p3_slice_elems): the "
-                    "in-flight-round re-push retains whole-tensor "
-                    "frames only. Disable one of the two.")
+            # P3 chunking composes with session resume: the retained
+            # re-push entry for a chunked round holds the round's FULL
+            # chunk-frame set (released when the round's pull reply
+            # lands), so a restarted server's lost round replays chunk
+            # by chunk through the same (sender, rid) / round dedup
             from geomx_tpu.transport import P3Slicer
             self._slicer = P3Slicer(p3_slice_elems)
         self._multi: Dict[int, list] = {}   # meta-rid -> per-chunk rids
@@ -385,6 +406,15 @@ class GeoPSClient:
                 elif self.reply_log is not None and \
                         msg.type == MsgType.PULL_REPLY:
                     self.reply_log.append((msg.key, None))
+                if msg.type == MsgType.PULL_REPLY and self._reconnect \
+                        and msg.key is not None:
+                    # the reply's "pushed" meta is the requester's
+                    # merged-round count at reply time (journaled
+                    # write-ahead of the reply): retained re-push
+                    # frames for rounds it covers are no longer needed
+                    self._release_push(msg.key,
+                                       proved_round=msg.meta.get(
+                                           "pushed"))
                 p.reply = msg
                 p.event.set()
 
@@ -403,8 +433,12 @@ class GeoPSClient:
         if out is None:
             return None
         p.parts = None
-        return Msg(MsgType.PULL_REPLY, key=msg.key,
-                   meta={"rid": msg.meta.get("rid")}, array=out)
+        meta = {"rid": msg.meta.get("rid")}
+        if msg.meta.get("pushed") is not None:
+            # the durability proof rides every chunk; keep it on the
+            # assembled reply for the retained-frame release
+            meta["pushed"] = msg.meta["pushed"]
+        return Msg(MsgType.PULL_REPLY, key=msg.key, meta=meta, array=out)
 
     # ---- session resume (docs/resilience.md "Host-plane recovery") --------
 
@@ -502,13 +536,15 @@ class GeoPSClient:
             prog = {str(k): int(v) for k, v in
                     dict(rep.meta.get("progress", {})).items()}
             for key, held in list(self._last_push.items()):
-                rnd, frame, prio = held
+                rnd, frames, prio = held
                 if prog.get(key, 0) < rnd:
                     # the restarted store is behind this client: the
                     # in-flight round died with the old incarnation —
-                    # re-push the retained frame (deduped by
-                    # (sender, rid) if it actually survived)
-                    self._sendq.push(frame, prio)
+                    # re-push the retained frame(s) (a P3-chunked round
+                    # replays its whole chunk set; the server's
+                    # (sender, rid) / round dedup absorbs survivors)
+                    for frame in frames:
+                        self._sendq.push(frame, prio)
             for key, srv_rnd in prog.items():
                 if srv_rnd > self._key_rounds.get(key, 0):
                     # server persisted rounds whose ACKs we never saw:
@@ -543,13 +579,55 @@ class GeoPSClient:
                 else:
                     p.event.set()
 
+    def _retain_push(self, key: str, rnd: int, frames: list,
+                     priority: int) -> None:
+        """Session resume: retain the CLEAN frame set of the newest push
+        per key, so a round a restarted server lost can be re-pushed
+        verbatim (one gradient per key of memory; a P3-chunked push
+        retains its full chunk set until the round's pull reply)."""
+        nbytes = sum(len(f) for f in frames)
+        with self._buf_lock:
+            prev = self._last_push.get(key)
+            if prev is not None:
+                freed = sum(len(f) for f in prev[1])
+                self._resend_buffer_bytes -= freed
+                self._m_resend_buf.dec(freed)
+            self._last_push[key] = (int(rnd), list(frames), priority)
+            self._resend_buffer_bytes += nbytes
+            self._m_resend_buf.inc(nbytes)
+
+    def _release_push(self, key: str,
+                      proved_round: Optional[int] = None) -> None:
+        """A pull reply proved the key durable server-side up to
+        ``proved_round`` (the requester's merged-round count the reply
+        carries, journaled write-ahead of it): release the retained
+        re-push frames for rounds it covers (satellite fix: the resend
+        buffer previously grew one frame per key forever).  A retained
+        round NEWER than the proof — a push pipelined after the pull
+        was issued — stays retained."""
+        with self._buf_lock:
+            held = self._last_push.get(key)
+            if held is None:
+                return
+            if proved_round is not None and held[0] > int(proved_round):
+                return
+            del self._last_push[key]
+            nbytes = sum(len(f) for f in held[1])
+            self._resend_buffer_bytes -= nbytes
+            self._m_resend_buf.dec(nbytes)
+
     def _submit(self, msg: Msg, priority: int = 0,
-                fire_and_forget: bool = False) -> int:
+                fire_and_forget: bool = False,
+                frame_out: Optional[list] = None) -> int:
         """Enqueue a request; returns its timestamp (request id).
 
         ``fire_and_forget``: no pending entry, no resend marking — the
         reply (if any) is ignored by the recv loop.  The best-effort DGT
-        deferred blocks' lossy-channel send."""
+        deferred blocks' lossy-channel send.
+
+        ``frame_out``: when given, the encoded CLEAN frame is appended —
+        the chunked-push path collects its chunk set for session-resume
+        retention."""
         rid = next(self._rid)
         msg.sender = self.sender_id
         msg.meta["rid"] = rid
@@ -577,14 +655,13 @@ class GeoPSClient:
             _log_msg("ENQ ", msg, len(frame))
         if resendable:
             p.frame, p.priority = frame, priority
+        if frame_out is not None:
+            frame_out.append(frame)
         if self._reconnect and msg.type == MsgType.PUSH \
                 and msg.meta.get("round") is not None \
                 and msg.meta.get("chunk") is None:
-            # session resume: retain the CLEAN frame of the newest push
-            # per key, so a round a restarted server lost can be
-            # re-pushed verbatim (one gradient per key of memory)
-            self._last_push[msg.key] = (int(msg.meta["round"]), frame,
-                                        priority)
+            self._retain_push(msg.key, int(msg.meta["round"]), [frame],
+                              priority)
         with self._plock:
             self._pending[rid] = p
         # chaos ``corrupt@``: the queued copy may get one bit flipped;
@@ -668,6 +745,10 @@ class GeoPSClient:
         if p.reply is None:
             raise ConnectionError("server closed")
         if p.reply.type == MsgType.ERROR:
+            if p.reply.meta.get("wrong_shard"):
+                raise WrongShardError(
+                    p.reply.meta.get("error", "wrong shard"),
+                    map_version=int(p.reply.meta.get("map_version", 0)))
             raise RuntimeError(p.reply.meta.get("error", "server error"))
         return p.reply
 
@@ -691,8 +772,16 @@ class GeoPSClient:
         g = np.asarray(grad)
         if g.dtype != np.float16:  # fp16 wire payloads keep their dtype
             g = g.astype(np.float32, copy=False)
-        rnd = self._key_rounds.get(key, 0) + 1
-        self._key_rounds[key] = rnd
+        m = dict(meta or {})
+        if m.get("round") is not None:
+            # an explicit round id (a sharded-tier wrapper owning round
+            # numbering across re-routes, or a recovery replay) wins;
+            # the local counter only ever catches UP to it
+            rnd = int(m["round"])
+            self._key_rounds[key] = max(self._key_rounds.get(key, 0), rnd)
+        else:
+            rnd = self._key_rounds.get(key, 0) + 1
+            self._key_rounds[key] = rnd
         # round-correlated client span (telemetry/tracing.py): the same
         # round_id the server threads through merge/relay/pull, so a
         # worker-side trace merges onto the WAN round timeline.  No-op
@@ -701,25 +790,31 @@ class GeoPSClient:
         get_profiler().instant(f"ClientPush:{key}", "kvstore",
                                args={"key": key, "round_id": rnd})
         if self._slicer is not None and g.size > self.p3_slice_elems \
-                and not meta:
+                and not (set(m) - {"round", "reliable"}):
             # P3: slice into priority-tagged chunks; each is an independent
             # resendable PUSH, reassembled server-side.  One key must not
             # have two chunked pushes from the same sender in flight (the
             # training loop pushes each key once per round, as the
-            # reference's does).
+            # reference's does).  Routing meta (round/reliable) rides
+            # every chunk; any other meta forces the whole-tensor path.
             flat = g.reshape(-1)
+            extra = {"reliable": True} if m.get("reliable") else {}
+            frames: Optional[list] = [] if self._reconnect else None
             rids = [self._submit(
                 Msg(MsgType.PUSH, key=key,
                     meta={"chunk": ch.index, "num_chunks": ch.num_chunks,
                           "start": ch.start, "n_total": int(g.size),
-                          "shape": list(g.shape), "round": rnd},
+                          "shape": list(g.shape), "round": rnd, **extra},
                     array=flat[ch.start:ch.stop]),
-                priority=priority)
+                priority=priority, frame_out=frames)
                 for ch in self._slicer.chunks(key, int(g.size), priority)]
+            if frames is not None:
+                # session resume for a CHUNKED round: retain the whole
+                # clean chunk set until the round's pull reply lands
+                self._retain_push(key, rnd, frames, priority)
             mrid = next(self._rid)
             self._multi[mrid] = rids
             return mrid
-        m = dict(meta or {})
         m.setdefault("round", rnd)
         return self._submit(Msg(MsgType.PUSH, key=key, meta=m, array=g),
                             priority=priority)
@@ -1309,6 +1404,16 @@ class GeoPSClient:
         if self._closed:
             return
         self._closed = True
+        # return this client's retained re-push bytes to the shared
+        # gauge (same sender label may outlive us — e.g. a failover
+        # rebuild — and must not inherit a dead client's balance)
+        with self._buf_lock:
+            freed = sum(sum(len(f) for f in h[1])
+                        for h in self._last_push.values())
+            self._last_push.clear()
+            if freed:
+                self._resend_buffer_bytes -= freed
+                self._m_resend_buf.dec(freed)
         self._closing.set()     # abort an in-flight reconnect promptly
         self._conn_ok.set()     # ... and a sender parked on it
         self._send_gate.set()  # release a paused sender so it can exit

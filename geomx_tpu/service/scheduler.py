@@ -74,6 +74,15 @@ class GeoScheduler:
         # and detect changes without diffing them
         self._epoch = 0
         self.heartbeats = HeartbeatMonitor(timeout_s=heartbeat_timeout)
+        # key-range sharded global tier (docs/resilience.md "Many-party
+        # global tier"): the scheduler OWNS the versioned shard map —
+        # clients fetch it here, failover re-points a shard's address,
+        # and rebalance_shards moves range boundaries from observed
+        # per-shard load (migrating the key state shard-to-shard).
+        # One rebalance at a time; the roster lock is never held across
+        # the shard RPCs a rebalance performs.
+        self._shard_map = None
+        self._rebalance_lock = threading.Lock()
 
         # ---- durability (docs/resilience.md "Host-plane recovery") -----
         # roster, id table and epoch persist through the shared
@@ -151,6 +160,17 @@ class GeoScheduler:
         self._m_req_s = reg.histogram(
             "geomx_scheduler_request_seconds",
             "Scheduler request handling latency")
+        self._m_shard_ver = reg.gauge(
+            "geomx_scheduler_shard_map_version",
+            "Version of the scheduler-owned global shard map")
+        self._m_rebalances = reg.counter(
+            "geomx_scheduler_shard_rebalances_total",
+            "Shard-map rebalances applied (boundary moves + migration)")
+        self._m_failovers = reg.counter(
+            "geomx_scheduler_shard_failovers_total",
+            "Shard failovers applied (address re-points)")
+        if self._shard_map is not None:
+            self._m_shard_ver.set(self._shard_map.version)
         # build-info gauge (the Prometheus idiom for version labels:
         # constant 1, identity in the labels) — what version/jax pairing
         # a scrape is actually talking to.  importlib.metadata avoids
@@ -202,7 +222,9 @@ class GeoScheduler:
                 "roster": {r: [list(e) for e in v]
                            for r, v in self._roster.items()},
                 "next": dict(self._next),
-                "epoch": self._epoch}
+                "epoch": self._epoch,
+                "shard_map": None if self._shard_map is None
+                else self._shard_map.to_meta()}
 
     def _journal(self, rec: dict) -> None:
         """Append one roster mutation; caller holds self._lock.  The
@@ -226,6 +248,9 @@ class GeoScheduler:
         self._next.update({k: int(v)
                            for k, v in state.get("next", {}).items()})
         self._epoch = int(state.get("epoch", 0))
+        if state.get("shard_map") is not None:
+            from geomx_tpu.service.shardmap import ShardMap
+            self._shard_map = ShardMap.from_meta(state["shard_map"])
         for rec in records:
             self._apply_durable_record(rec)
         return True
@@ -258,6 +283,12 @@ class GeoScheduler:
                 if v0 == node:
                     del self._assigned[k0]
             self._epoch = max(self._epoch, int(rec.get("epoch", 0)))
+        elif kind == "shard_map":
+            from geomx_tpu.service.shardmap import ShardMap
+            m = ShardMap.from_meta(rec["map"])
+            if self._shard_map is None or m.version >= \
+                    self._shard_map.version:
+                self._shard_map = m
 
     def in_restart_grace(self) -> bool:
         """True while the post-restart re-registration grace window is
@@ -274,6 +305,13 @@ class GeoScheduler:
             epoch = self._epoch
             roster = {role: len(nodes)
                       for role, nodes in sorted(self._roster.items())}
+            shard_map_version = None if self._shard_map is None \
+                else self._shard_map.version
+            num_shards = None if self._shard_map is None \
+                else self._shard_map.num_shards
+        # the dead/alive sweeps run OUTSIDE every lock (the monitor
+        # snapshots its beat table internally): a 32-party scan can no
+        # longer stall register/heartbeat RPCs behind /healthz
         alive = self.heartbeats.alive_nodes()
         dead = [] if self.in_restart_grace() \
             else self.heartbeats.dead_nodes()
@@ -285,11 +323,152 @@ class GeoScheduler:
             "dead_parties": len(dead),
             "dead_node_ids": dead,
             "restart_grace": self.in_restart_grace(),
+            "shard_map_version": shard_map_version,
+            "num_shards": num_shards,
             "generation": self.generation,
             "uptime_s": round(time.monotonic() - self._started_monotonic,
                               3),
             "build": dict(self.build_info),
         }
+
+    # ---- key-range sharded global tier (scheduler-owned placement) ---------
+
+    @staticmethod
+    def _shard_cmd(addr, meta: dict, timeout: float = 60.0) -> dict:
+        """One synchronous COMMAND round-trip to a shard server (the
+        scheduler's admin line for range installs and key migration)."""
+        sock = connect_retry(tuple(addr), total_timeout_s=15.0)
+        try:
+            sock.settimeout(timeout)
+            msg = Msg(MsgType.COMMAND, meta=dict(meta))
+            msg.meta.setdefault("rid", 0)
+            send_frame(sock, msg)
+            rep = recv_frame(sock)
+            if rep is None:
+                raise ConnectionError(f"shard {addr} closed")
+            if rep.type == MsgType.ERROR:
+                raise RuntimeError(rep.meta.get("error", "shard error"))
+            return dict(rep.meta)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def rebalance_shards(self, min_gain=None) -> dict:
+        """Move range boundaries toward the observed load and migrate
+        the affected key state (docs/resilience.md "Many-party global
+        tier").  Three phases, each safe against a crash or a client
+        racing with a stale map:
+
+        1. every shard's range shrinks to the INTERSECTION of its old
+           and new range (version = new) — all moved segments are
+           quiesced tier-wide: requests for them redirect, so no merge
+           can land on a shard mid-migration;
+        2. each moved segment's key state is COPIED from the old owner
+           (``export_keys remove=False`` — including the open round's
+           per-sender contributions), imported into the new owner
+           (journaled there), and only THEN dropped at the old owner
+           (``drop_keys``, journaled) — a crash or failed import
+           between copy and drop leaves the keys intact on the
+           quiesced loser, so a re-run of the rebalance (or the
+           no-change range re-assert below) converges with nothing
+           lost.  The one remaining torn window — a crash after a
+           drop but before the final range installs — leaves the
+           moved keys journaled at the GAINER only; requests then
+           fail LOUDLY ("no key") at the map's owner rather than
+           silently diverging, and recovery is re-running
+           ``rebalance_shards`` once loads re-skew (or importing the
+           gainer's journal);
+        3. every shard installs its final range, then the scheduler
+           installs (and journals) the bumped map.
+
+        A client redirected during the window retries after a map
+        re-fetch; its replayed pushes are idempotent under the migrated
+        per-sender round counts.  Returns ``{"changed", "map",
+        "moved_keys", "segments"}``."""
+        from geomx_tpu.config import _env
+        from geomx_tpu.service.shardmap import (moved_segments,
+                                                rebalance_bounds)
+        if min_gain is None:
+            min_gain = _env(("GEOMX_SHARD_REBALANCE_MIN_GAIN",), 0.10,
+                            float)
+        with self._rebalance_lock:
+            with self._lock:
+                cur = self._shard_map
+            if cur is None:
+                raise RuntimeError("no shard map installed")
+            if cur.num_shards < 2:
+                return {"changed": False, "map": cur.to_meta(),
+                        "moved_keys": 0, "segments": 0}
+            key_loads: dict = {}
+            for i in range(cur.num_shards):
+                load = self._shard_cmd(
+                    cur.addr_of(i),
+                    {"cmd": "shard_load", "reset": True})["load"]
+                for k, c in dict(load.get("keys", {})).items():
+                    key_loads[k] = key_loads.get(k, 0.0) + float(c)
+            bounds = rebalance_bounds(cur, key_loads,
+                                      min_gain=float(min_gain))
+            if tuple(bounds) == tuple(cur.bounds):
+                # no boundary move — but RE-ASSERT the current map's
+                # ranges anyway: a rebalance that crashed between its
+                # quiesce and its final installs left shards holding
+                # shrunk intersection ranges at a version the map never
+                # reached, and this is the re-run that heals them (the
+                # keys were never dropped before their import was
+                # acknowledged, so ownership simply snaps back)
+                for i in range(cur.num_shards):
+                    lo, hi = cur.range_of(i)
+                    self._shard_cmd(cur.addr_of(i), {
+                        "cmd": "set_shard_range", "lo": lo, "hi": hi,
+                        "version": cur.version})
+                return {"changed": False, "map": cur.to_meta(),
+                        "moved_keys": 0, "segments": 0}
+            new = cur.with_bounds(bounds)
+            segs = moved_segments(cur, new)
+            # phase 1: quiesce every moved segment
+            for i in range(new.num_shards):
+                olo, ohi = cur.range_of(i)
+                nlo, nhi = new.range_of(i)
+                ilo, ihi = max(olo, nlo), min(ohi, nhi)
+                if ilo >= ihi:
+                    ilo = ihi = nlo  # disjoint: own nothing until ph. 3
+                self._shard_cmd(new.addr_of(i), {
+                    "cmd": "set_shard_range", "lo": ilo, "hi": ihi,
+                    "version": new.version})
+            # phase 2: migrate each quiesced segment — copy, import,
+            # and only then drop (never a window where the state exists
+            # nowhere durable)
+            moved = 0
+            for lo, hi, old_owner, new_owner in segs:
+                recs = self._shard_cmd(cur.addr_of(old_owner), {
+                    "cmd": "export_keys", "lo": lo, "hi": hi,
+                    "remove": False})["records"]
+                if recs:
+                    self._shard_cmd(new.addr_of(new_owner), {
+                        "cmd": "import_keys", "records": dict(recs)})
+                    self._shard_cmd(cur.addr_of(old_owner), {
+                        "cmd": "drop_keys", "lo": lo, "hi": hi})
+                moved += len(recs)
+            # phase 3: final ranges, then the map
+            for i in range(new.num_shards):
+                nlo, nhi = new.range_of(i)
+                self._shard_cmd(new.addr_of(i), {
+                    "cmd": "set_shard_range", "lo": nlo, "hi": nhi,
+                    "version": new.version})
+            with self._lock:
+                self._shard_map = new
+                self._journal({"k": "shard_map", "map": new.to_meta()})
+                self._m_shard_ver.set(new.version)
+            self._m_rebalances.inc()
+            from geomx_tpu.utils.profiler import get_profiler
+            get_profiler().instant(
+                "ShardRebalance", "scheduler",
+                args={"map_version": new.version, "moved_keys": moved,
+                      "segments": len(segs)})
+            return {"changed": True, "map": new.to_meta(),
+                    "moved_keys": moved, "segments": len(segs)}
 
     def _start_metrics_http(self, bind_host: str, port: int) -> None:
         """Serve ``GET /metrics`` (Prometheus text exposition of the
@@ -603,6 +782,51 @@ class GeoScheduler:
                 self.heartbeats.dead_nodes(msg.meta.get("timeout"))
             self._reply(conn, msg, Msg(MsgType.ACK, meta={
                 "dead": dead, "grace": self.in_restart_grace()}))
+        elif cmd == "init_shard_map":
+            # install the version-1 even-bounds map over the given shard
+            # addresses.  Idempotent: a second init (a racing bring-up)
+            # returns the installed map unchanged.
+            from geomx_tpu.service.shardmap import ShardMap
+            with self._lock:
+                if self._shard_map is None:
+                    self._shard_map = ShardMap.initial(
+                        (h, int(p)) for h, p in msg.meta["shards"])
+                    self._journal({"k": "shard_map",
+                                   "map": self._shard_map.to_meta()})
+                    self._m_shard_ver.set(self._shard_map.version)
+                m = self._shard_map.to_meta()
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={"map": m}))
+        elif cmd == "shard_map":
+            with self._lock:
+                m = None if self._shard_map is None \
+                    else self._shard_map.to_meta()
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={"map": m}))
+        elif cmd == "shard_failover":
+            # a shard missed its restart window: its journal replayed
+            # into a replacement server on a NEW port — re-point the
+            # map entry and bump the version so clients redirect
+            idx = int(msg.meta["index"])
+            host, port = msg.meta["host"], int(msg.meta["port"])
+            with self._lock:
+                if self._shard_map is None:
+                    raise RuntimeError("no shard map installed")
+                self._shard_map = self._shard_map.with_address(
+                    idx, host, port)
+                self._journal({"k": "shard_map",
+                               "map": self._shard_map.to_meta()})
+                self._m_shard_ver.set(self._shard_map.version)
+                m = self._shard_map.to_meta()
+            self._m_failovers.inc()
+            from geomx_tpu.utils.profiler import get_profiler
+            get_profiler().instant(
+                "ShardFailover", "scheduler",
+                args={"shard": idx, "port": port,
+                      "map_version": m["version"]})
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={"map": m}))
+        elif cmd == "rebalance_shards":
+            result = self.rebalance_shards(
+                min_gain=msg.meta.get("min_gain"))
+            self._reply(conn, msg, Msg(MsgType.ACK, meta=result))
         else:
             self._reply(conn, msg, Msg(MsgType.ERROR,
                                        meta={"error": f"bad cmd {cmd}"}))
@@ -793,6 +1017,51 @@ class SchedulerClient:
         protocol (the COMMAND twin of its GET /metrics endpoint)."""
         return str(self._rpc(Msg(MsgType.COMMAND,
                                  meta={"cmd": "metrics"})).meta["text"])
+
+    # ---- key-range sharded global tier ------------------------------------
+
+    def init_shard_map(self, addrs) -> dict:
+        """Install (idempotently) the version-1 even-bounds shard map
+        over ``addrs`` = [(host, port), ...]; returns the map meta."""
+        return dict(self._rpc(Msg(MsgType.COMMAND, meta={
+            "cmd": "init_shard_map",
+            "shards": [[h, int(p)] for h, p in addrs]})).meta["map"])
+
+    def shard_map(self) -> Optional[dict]:
+        """The current shard-map meta, or None before init."""
+        m = self._rpc(Msg(MsgType.COMMAND,
+                          meta={"cmd": "shard_map"})).meta.get("map")
+        return None if m is None else dict(m)
+
+    def wait_shard_map(self, timeout: float = 60.0,
+                       min_version: int = 0) -> dict:
+        """Poll until a map with ``version >= min_version`` is
+        installed — the client-side half of a map-bump redirect."""
+        deadline = time.monotonic() + timeout
+        while True:
+            m = self.shard_map()
+            if m is not None and int(m["version"]) >= int(min_version):
+                return m
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no shard map at version >= {min_version} within "
+                    f"{timeout}s")
+            time.sleep(0.05)
+
+    def shard_failover(self, index: int, host: str, port: int) -> dict:
+        """Re-point shard ``index`` at a replacement server (journal
+        replayed on a new port); returns the bumped map meta."""
+        return dict(self._rpc(Msg(MsgType.COMMAND, meta={
+            "cmd": "shard_failover", "index": int(index),
+            "host": host, "port": int(port)})).meta["map"])
+
+    def rebalance_shards(self, min_gain: Optional[float] = None) -> dict:
+        """Ask the scheduler to rebalance ranges from observed load;
+        returns {"changed", "map", "moved_keys", "segments"}."""
+        meta = {"cmd": "rebalance_shards"}
+        if min_gain is not None:
+            meta["min_gain"] = float(min_gain)
+        return dict(self._rpc(Msg(MsgType.COMMAND, meta=meta)).meta)
 
     def stop_scheduler(self) -> None:
         try:

@@ -42,7 +42,18 @@ from geomx_tpu.utils.heartbeat import HeartbeatMonitor
 class _KeyState:
     def __init__(self, value: np.ndarray):
         self.value = value.copy()
-        self.merged: Optional[np.ndarray] = None
+        # this round's per-sender contributions.  Kept SEPARATE (not a
+        # running sum) so the round merge sums in sorted-sender order:
+        # float addition is commutative but not associative, and at
+        # 16+ parties an arrival-ordered running sum would make the
+        # merged bits depend on thread scheduling — the many-party
+        # bit-exact chaos gate (bench --compare-manyparty) and shard
+        # migration both need arrival-order-independent merges.
+        # Cost: up to num_workers gradients per key held for the open
+        # round (vs one accumulated array before) — a deliberate
+        # host-plane trade; key-range sharding divides it by the shard
+        # count, and the buffers free at every round gate.
+        self.contribs: Dict[int, np.ndarray] = {}
         self.count = 0
         self.round = 0            # completed merge rounds
         self.pushed: Dict[int, int] = {}   # sender -> rounds pushed
@@ -82,7 +93,10 @@ class GeoPSServer:
                  global_ts_node: Optional[int] = None,
                  durable_dir: Optional[str] = None,
                  durable_name: Optional[str] = None,
-                 reconnect: Optional[bool] = None):
+                 reconnect: Optional[bool] = None,
+                 shard_range: Optional[tuple] = None,
+                 shard_index: Optional[int] = None,
+                 shard_map_version: int = 0):
         """``accumulate=True`` makes the no-optimizer store add pushes into
         the value instead of overwriting it — the ps-lite default server
         handle (KVServerDefaultHandle), used by its micro-tests; overwrite
@@ -97,7 +111,18 @@ class GeoPSServer:
         durable state, and every reply carries a per-start generation
         token so clients detect the restart and run the session-resume
         handshake.  ``reconnect`` arms that handshake on this server's
-        OWN upstream clients (the WAN relay to the global tier)."""
+        OWN upstream clients (the WAN relay to the global tier).
+
+        ``shard_range=(lo, hi)`` makes this server ONE SHARD of a
+        key-range sharded global tier (docs/resilience.md "Many-party
+        global tier"): it owns keys with ``lo <= key_hash(key) < hi``
+        and answers any other key with a ``wrong_shard`` redirect
+        carrying ``shard_map_version`` — a client holding a stale map
+        re-fetches the scheduler's map instead of merging into the
+        wrong store.  The range/version can be updated live
+        (``set_shard_range``) and key state migrates between shards via
+        ``export_keys``/``import_keys`` (the scheduler's rebalance
+        drives both)."""
         self.num_workers = num_workers
         self.mode = mode
         self.accumulate = accumulate
@@ -239,6 +264,28 @@ class GeoPSServer:
             "geomx_server_num_workers",
             "Current sync-gate width", ("rank",)).labels(_r)
         self._m_workers.set(num_workers)
+
+        # ---- key-range sharding (docs/resilience.md "Many-party
+        # global tier"): owned hash range + the map version redirects
+        # carry, plus the windowed load counters the scheduler's
+        # rebalance reads (per-key push counts since the last window
+        # reset — observation-driven placement)
+        self._shard_range = None if shard_range is None else \
+            (int(shard_range[0]), int(shard_range[1]))
+        self.shard_index = shard_index
+        self.shard_map_version = int(shard_map_version)
+        self._load_pushes = 0
+        self._load_pulls = 0
+        self._load_key_pushes: Dict[str, int] = {}
+        self._m_shard_ver = _reg.gauge(
+            "geomx_shard_map_version",
+            "Shard-map version this server last installed",
+            ("rank",)).labels(_r)
+        self._m_shard_keys = _reg.gauge(
+            "geomx_shard_keys",
+            "Keys currently owned by this server/shard",
+            ("rank",)).labels(_r)
+        self._m_shard_ver.set(self.shard_map_version)
 
         # MultiGPS: N global servers with reference placement (hash small
         # tensors whole, split big ones across all servers —
@@ -572,7 +619,10 @@ class GeoPSServer:
                          for key, st in self._store.items()},
                 "num_workers": self.num_workers,
                 "evicted": sorted(self._evicted),
-                "tx_config": self._tx_config}
+                "tx_config": self._tx_config,
+                "shard_range": None if self._shard_range is None
+                else list(self._shard_range),
+                "map_version": self.shard_map_version}
 
     def _apply_durable_key(self, key: str, rec: dict) -> None:
         st = self._store.get(key)
@@ -584,7 +634,7 @@ class GeoPSServer:
                      for s, n in dict(rec.get("pushed", {})).items()}
         st.milestone = None if rec.get("milestone") is None \
             else np.asarray(rec["milestone"]).copy()
-        st.merged, st.count = None, 0
+        st.contribs, st.count = {}, 0
         st.rs_rows, st.rs_vals = [], []
         blob = rec.get("opt")
         if blob is not None and self._tx is not None:
@@ -621,6 +671,13 @@ class GeoPSServer:
                 state["num_workers"] = int(rec["num_workers"])
             elif kind == "optimizer":
                 state["tx_config"] = (rec["name"], rec.get("kwargs", {}))
+            elif kind == "shard_range":
+                state["shard_range"] = [int(rec["lo"]), int(rec["hi"])]
+                state["map_version"] = int(rec.get("version", 0))
+            elif kind == "drop_keys":
+                # keys that migrated off this shard must not resurrect
+                for k0 in rec.get("keys", []):
+                    state["keys"].pop(k0, None)
         if state.get("tx_config"):
             name, kwargs = state["tx_config"]
             self._set_optimizer_locked(name, dict(kwargs))
@@ -633,6 +690,182 @@ class GeoPSServer:
         if state.get("num_workers") is not None:
             self.num_workers = int(state["num_workers"])
             self._m_workers.set(self.num_workers)
+        sr = state.get("shard_range")
+        if sr is not None and int(state.get("map_version", 0)) >= \
+                self.shard_map_version:
+            # the journaled range is at least as fresh as the
+            # constructor's: a restarted shard resumes the range it
+            # last installed (a rebalance may have moved it)
+            self._shard_range = (int(sr[0]), int(sr[1]))
+            self.shard_map_version = int(state.get("map_version", 0))
+            self._m_shard_ver.set(self.shard_map_version)
+        self._m_shard_keys.set(len(self._store))
+
+    # ---- key-range sharding: migration + redirect helpers ------------------
+
+    @staticmethod
+    def _enc_arr(a) -> Optional[dict]:
+        """numpy array -> wire-primitive dict (meta headers carry only
+        primitives; pickled ndarrays would be refused by the hardened
+        header unpickler)."""
+        if a is None:
+            return None
+        a = np.ascontiguousarray(a)
+        return {"d": a.dtype.str, "s": list(a.shape), "b": a.tobytes()}
+
+    @staticmethod
+    def _dec_arr(e) -> Optional[np.ndarray]:
+        if e is None:
+            return None
+        return np.frombuffer(e["b"], dtype=np.dtype(e["d"])).reshape(
+            e["s"]).copy()
+
+    def _wrong_shard_reply_locked(self, key: str) -> Optional[Msg]:
+        """The locked re-check of the (unlocked, fast-path) range gate
+        in ``_handle``: a push that passed the fast path can reach the
+        merge AFTER a rebalance shrank the range and copied the key
+        out — merging then would strand an ACKed contribution on a key
+        the paired ``drop_keys`` is about to erase.  Returns the
+        redirect to send (caller holds self._lock), or None when the
+        key is owned."""
+        if self._shard_range is None or key is None:
+            return None
+        from geomx_tpu.service.shardmap import key_hash
+        lo, hi = self._shard_range
+        if lo <= key_hash(key) < hi:
+            return None
+        return Msg(MsgType.ERROR, meta={
+            "error": f"key {key!r} is outside this shard's range "
+                     f"[{lo}, {hi}) at map version "
+                     f"{self.shard_map_version}",
+            "wrong_shard": True,
+            "map_version": self.shard_map_version})
+
+    def _redirect_out_of_range_locked(self) -> None:
+        """After a range shrink: parked pulls for keys this shard no
+        longer owns must redirect (their round will complete at the new
+        owner), not stall forever.  Caller holds self._lock."""
+        if self._shard_range is None:
+            return
+        from geomx_tpu.service.shardmap import key_hash
+        lo, hi = self._shard_range
+        for key, st in self._store.items():
+            if lo <= key_hash(key) < hi or not st.waiting_pulls:
+                continue
+            waiters, st.waiting_pulls = st.waiting_pulls, []
+            for c, req, _need in waiters:
+                err = Msg(MsgType.ERROR, meta={
+                    "error": f"key {key!r} moved off this shard "
+                             f"(map version {self.shard_map_version})",
+                    "wrong_shard": True,
+                    "map_version": self.shard_map_version})
+                rid = req.meta.get("rid")
+                if rid is not None:
+                    err.meta["rid"] = rid
+                try:
+                    self._send_msg(c, err)
+                except OSError:
+                    pass
+
+    def _snapshot_key_locked(self, key: str) -> dict:
+        """One key's FULL state — durable fields plus the open round's
+        in-flight per-sender contributions — as a wire-primitive
+        record.  Read-only (migration copies first, drops only after
+        the import is acknowledged).  Caller holds self._lock."""
+        st = self._store[key]
+        rec = {"value": self._enc_arr(st.value), "round": int(st.round),
+               "pushed": {int(s): int(n) for s, n in st.pushed.items()},
+               "milestone": self._enc_arr(st.milestone),
+               "opt": self._opt_blob(key), "comp": None,
+               "count": int(st.count),
+               "contribs": {int(s): self._enc_arr(g)
+                            for s, g in st.contribs.items()},
+               "relay_error": st.relay_error}
+        comp = self._comp_state.get(key) \
+            if self._compressor is not None else None
+        if isinstance(comp, tuple) and comp and \
+                all(isinstance(a, np.ndarray) for a in comp):
+            rec["comp"] = [self._enc_arr(a) for a in comp]
+        return rec
+
+    def _drop_keys_locked(self, keys) -> None:
+        """Forget migrated keys: pop every trace of them — store,
+        optimizer/compressor state, in-flight P3 assemblies, armed DGT
+        deadlines, load-window counters — journal the drop (a restarted
+        loser must not resurrect moved keys) and redirect parked pulls
+        (their rounds complete at the importing shard).  Caller holds
+        self._lock."""
+        dropped = []
+        for key in keys:
+            st = self._store.pop(key, None)
+            if st is None:
+                continue
+            dropped.append(key)
+            self._opt_state.pop(key, None)
+            if self._compressor is not None:
+                self._comp_state.pop(key, None)
+            self._load_key_pushes.pop(key, None)
+            for pk in [pk for pk in list(self._p3_partial)
+                       if pk[1] == key]:
+                self._p3_partial.pop(pk, None)
+            for pk in [pk for pk in list(self._dgt_pending)
+                       if pk[1] == key]:
+                self._dgt_untrack(pk)
+            for c, req, _need in st.waiting_pulls:
+                err = Msg(MsgType.ERROR, meta={
+                    "error": f"key {key!r} migrated off this shard",
+                    "wrong_shard": True,
+                    "map_version": self.shard_map_version})
+                rid = req.meta.get("rid")
+                if rid is not None:
+                    err.meta["rid"] = rid
+                try:
+                    self._send_msg(c, err)
+                except OSError:
+                    pass
+        if dropped:
+            self._journal({"k": "drop_keys", "keys": dropped})
+        self._m_shard_keys.set(len(self._store))
+
+    def _import_key_locked(self, key: str, rec: dict) -> None:
+        """Install a migrated key record (the gainer side of a
+        rebalance): durable fields journal immediately, the open
+        round's contributions stay in-memory — exactly a round in
+        flight.  Idempotent round-wise: migrated ``pushed`` counts make
+        a re-routed client's replayed push an idempotent ACK.  Caller
+        holds self._lock."""
+        value = self._dec_arr(rec["value"])
+        st = self._store.get(key)
+        if st is None:
+            st = self._store[key] = _KeyState(value)
+        st.value = value
+        st.round = int(rec.get("round", 0))
+        st.pushed = {int(s): int(n)
+                     for s, n in dict(rec.get("pushed", {})).items()}
+        st.milestone = self._dec_arr(rec.get("milestone"))
+        st.contribs = {int(s): self._dec_arr(g)
+                       for s, g in dict(rec.get("contribs", {})).items()}
+        st.count = int(rec.get("count", 0))
+        st.relay_error = rec.get("relay_error")
+        blob = rec.get("opt")
+        if self._tx is not None:
+            if blob is not None:
+                from geomx_tpu.utils.checkpoint import tree_from_bytes
+                self._opt_state[key] = tree_from_bytes(blob)
+            elif key not in self._opt_state:
+                self._opt_state[key] = self._tx.init(st.value)
+        if self._compressor is not None:
+            comp = rec.get("comp")
+            self._comp_state[key] = tuple(
+                self._dec_arr(a) for a in comp) if comp else \
+                self._compressor.init_leaf_state(st.value)
+        jrec = {"k": "round", "key": key}
+        jrec.update(self._key_record(key, st))
+        self._journal(jrec)
+        if 0 < st.count and st.count >= self.num_workers:
+            # the migrated open round already satisfies this shard's
+            # gate (e.g. the last pusher re-routed before the move)
+            self._complete_merge_locked(key, st)
 
     # ---- networking --------------------------------------------------------
 
@@ -727,10 +960,28 @@ class GeoPSServer:
         t = msg.type
         if msg.sender >= 0:
             self.heartbeats.heartbeat(msg.sender)
+        if self._shard_range is not None and msg.key is not None and \
+                t in (MsgType.INIT, MsgType.PUSH, MsgType.PULL):
+            from geomx_tpu.service.shardmap import key_hash
+            lo, hi = self._shard_range
+            if not lo <= key_hash(msg.key) < hi:
+                # stale shard map: REDIRECT, never a wrong-shard merge.
+                # The version tells the client how fresh a map to fetch.
+                self._reply(conn, msg, Msg(MsgType.ERROR, meta={
+                    "error": f"key {msg.key!r} is outside this shard's "
+                             f"range [{lo}, {hi}) at map version "
+                             f"{self.shard_map_version}",
+                    "wrong_shard": True,
+                    "map_version": self.shard_map_version}))
+                return False
         if t == MsgType.HEARTBEAT:
             self._reply(conn, msg, Msg(MsgType.ACK))
         elif t == MsgType.INIT:
             with self._lock:
+                redirect = self._wrong_shard_reply_locked(msg.key)
+                if redirect is not None:
+                    self._reply(conn, msg, redirect)
+                    return False
                 if msg.key not in self._store:
                     self._store[msg.key] = _KeyState(msg.array)
                     if self.hfa_k2 is not None:
@@ -767,6 +1018,7 @@ class GeoPSServer:
                         rec = {"k": "init", "key": msg.key}
                         rec.update(self._key_record(msg.key, st0))
                         self._journal(rec)
+                self._m_shard_keys.set(len(self._store))
             self._reply(conn, msg, Msg(MsgType.ACK, key=msg.key))
         elif t == MsgType.PUSH:
             self._handle_push(conn, msg)
@@ -923,10 +1175,15 @@ class GeoPSServer:
             # The generation token rides every reply already; hello
             # exists so a RECONNECTING client can learn it before
             # deciding whether to replay (docs/resilience.md)
-            self._reply(conn, msg, Msg(MsgType.ACK, meta={
-                "gen": self.generation, "rank": self.rank,
-                "mode": self.mode, "num_workers": self.num_workers,
-                "durable": self._durable is not None}))
+            hello = {"gen": self.generation, "rank": self.rank,
+                     "mode": self.mode, "num_workers": self.num_workers,
+                     "durable": self._durable is not None}
+            if self._shard_range is not None:
+                hello.update({"shard_index": self.shard_index,
+                              "shard_lo": self._shard_range[0],
+                              "shard_hi": self._shard_range[1],
+                              "map_version": self.shard_map_version})
+            self._reply(conn, msg, Msg(MsgType.ACK, meta=hello))
             return
         elif cmd == "query_progress":
             # recovery state for a (re)joining worker: its per-key merged
@@ -944,6 +1201,71 @@ class GeoPSServer:
                 meta={"dead": self.heartbeats.dead_nodes(
                     msg.meta.get("timeout"))}))
             return
+        elif cmd == "shard_info":
+            with self._lock:
+                info = {"shard_index": self.shard_index,
+                        "map_version": self.shard_map_version,
+                        "num_keys": len(self._store)}
+                if self._shard_range is not None:
+                    info["lo"], info["hi"] = self._shard_range
+            self._reply(conn, msg, Msg(MsgType.ACK, meta=info))
+            return
+        elif cmd == "set_shard_range":
+            # scheduler-driven range install (rebalance step 1 shrinks
+            # the loser FIRST, quiescing the moved segment before its
+            # keys export — in-flight clients redirect and retry)
+            lo, hi = int(msg.meta["lo"]), int(msg.meta["hi"])
+            ver = int(msg.meta.get("version", 0))
+            with self._lock:
+                self._shard_range = (lo, hi)
+                self.shard_map_version = max(self.shard_map_version, ver)
+                self._m_shard_ver.set(self.shard_map_version)
+                self._journal({"k": "shard_range", "lo": lo, "hi": hi,
+                               "version": self.shard_map_version})
+                self._redirect_out_of_range_locked()
+        elif cmd == "shard_load":
+            # windowed load observation: per-key push counts since the
+            # last reset — the scheduler's rebalance input
+            with self._lock:
+                load = {"pushes": self._load_pushes,
+                        "pulls": self._load_pulls,
+                        "keys": dict(self._load_key_pushes),
+                        "num_keys": len(self._store)}
+                if msg.meta.get("reset"):
+                    self._load_pushes = self._load_pulls = 0
+                    self._load_key_pushes = {}
+            self._reply(conn, msg, Msg(MsgType.ACK, meta={"load": load}))
+            return
+        elif cmd == "export_keys":
+            # COPY the range's key state out (``remove=True`` also
+            # drops it).  The scheduler's rebalance exports with
+            # remove=False and only issues the paired ``drop_keys``
+            # AFTER the gainer acknowledged the import — a crash or a
+            # failed import between the two leaves the keys intact on
+            # the (quiesced) loser, retryable, never lost.
+            lo, hi = int(msg.meta["lo"]), int(msg.meta["hi"])
+            from geomx_tpu.service.shardmap import key_hash
+            with self._lock:
+                records = {key: self._snapshot_key_locked(key)
+                           for key in sorted(self._store)
+                           if lo <= key_hash(key) < hi}
+                if msg.meta.get("remove", True):
+                    self._drop_keys_locked(sorted(records))
+            self._reply(conn, msg, Msg(MsgType.ACK,
+                                       meta={"records": records}))
+            return
+        elif cmd == "drop_keys":
+            lo, hi = int(msg.meta["lo"]), int(msg.meta["hi"])
+            from geomx_tpu.service.shardmap import key_hash
+            with self._lock:
+                self._drop_keys_locked(
+                    [key for key in sorted(self._store)
+                     if lo <= key_hash(key) < hi])
+        elif cmd == "import_keys":
+            with self._lock:
+                for key, rec in dict(msg.meta["records"]).items():
+                    self._import_key_locked(str(key), rec)
+                self._m_shard_keys.set(len(self._store))
         elif cmd == "evict_worker":
             # resilience/: un-stall the sync gate after a worker death
             # (the liveness controller or an operator decides WHEN; the
@@ -1355,6 +1677,17 @@ class GeoPSServer:
             self.push_log.append((msg.sender, key, msg.meta.get("chunk")))
             if len(self.push_log) > 65536:
                 del self.push_log[:32768]
+            # windowed load observation (scheduler rebalance input)
+            self._load_pushes += 1
+            self._load_key_pushes[key] = \
+                self._load_key_pushes.get(key, 0) + 1
+            redirect = self._wrong_shard_reply_locked(key)
+            if redirect is not None:
+                # locked re-check of the fast-path range gate: a
+                # rebalance shrank the range after this push passed it —
+                # redirect BEFORE any dedup/chunk state records it
+                self._reply(conn, msg, redirect)
+                return
             if sig is not None:
                 prior = self._seen_pushes.get(sig)
                 if prior is True:
@@ -1534,6 +1867,14 @@ class GeoPSServer:
         ``sig`` is the push's resend-dedup signature: an async-mode relay
         parks it until the relayed value installs, so retransmits of the
         in-flight push are neither re-merged nor falsely ACKed."""
+        redirect = self._wrong_shard_reply_locked(key)
+        if redirect is not None:
+            # the range moved between the unlocked fast-path check and
+            # this merge (rebalance quiesce): redirect, never merge
+            if sig is not None:
+                self._seen_pushes.pop(sig, None)
+            self._reply(conn, msg, redirect)
+            return
         st = self._store[key]
         if rs is not None and self.hfa_k2 is not None:
             self._reply(conn, msg, Msg(MsgType.ERROR, meta={
@@ -1567,6 +1908,16 @@ class GeoPSServer:
                 return
             else:
                 self._apply(key, grad)
+            r0 = msg.meta.get("round")
+            if r0 is not None and msg.sender >= 0:
+                # async mode counts merged rounds per sender too:
+                # query_progress and the pull-reply durability proof
+                # (the client's retained-frame release) need it —
+                # bumped HERE, where the apply+journal happen under
+                # one lock hold, never at relay park time (a parked
+                # round is not yet durable)
+                st.pushed[msg.sender] = max(
+                    st.pushed.get(msg.sender, 0), int(r0))
             st.round += 1
             self._journal_round(key, st)  # async apply = one round
             self._reply(conn, msg, Msg(MsgType.ACK, key=key))
@@ -1591,7 +1942,7 @@ class GeoPSServer:
             return
         # dense and row-sparse pushes must not mix within one sync round:
         # the round gate would have to invent semantics for the overlap
-        if rs is not None and st.merged is not None or \
+        if rs is not None and st.contribs or \
                 rs is None and st.rs_rows:
             self._reply(conn, msg, Msg(MsgType.ERROR, meta={
                 "error": "dense and row-sparse pushes mixed in one sync "
@@ -1601,7 +1952,8 @@ class GeoPSServer:
             st.rs_rows.append(rs[0])
             st.rs_vals.append(rs[1])
         else:
-            st.merged = grad if st.merged is None else st.merged + grad
+            prev = st.contribs.get(msg.sender)
+            st.contribs[msg.sender] = grad if prev is None else prev + grad
         # a TS relay-merged push carries the contributions of num_merge
         # workers (reference KVMeta.num_merge counting toward the sync
         # gate, kvstore_dist_server.h:1324)
@@ -1616,8 +1968,20 @@ class GeoPSServer:
         and finish the round.  Caller holds self._lock and has checked
         ``st.count >= self.num_workers``.  Factored out of _push_locked
         so worker eviction (resilience/) can close rounds the evicted
-        worker would otherwise stall forever."""
-        merged, st.merged, st.count = st.merged, None, 0
+        worker would otherwise stall forever.
+
+        The merge sums the per-sender contributions in SORTED sender
+        order: float addition is not associative, so an arrival-ordered
+        running sum would tie the merged bits to thread scheduling —
+        sorted-order summation is what makes a 16+-party chaos replay
+        bit-exact against its uninterrupted baseline."""
+        merged = None
+        if st.contribs:
+            parts = [st.contribs[s] for s in sorted(st.contribs)]
+            merged = parts[0]
+            for g in parts[1:]:
+                merged = merged + g
+        st.contribs, st.count = {}, 0
         rnd = st.round + 1  # the round this merge completes
         self.profiler.instant(f"ServerMerge:{key}", "kvstore",
                               args={"key": key, "round_id": rnd})
@@ -1728,7 +2092,9 @@ class GeoPSServer:
                     args={"key": key, "round_id": st.round,
                           "sender": req.sender})
                 try:
-                    self._reply_pull_value(c, req, key, val)
+                    self._reply_pull_value(
+                        c, req, key, val,
+                        pushed=st.pushed.get(req.sender, 0))
                 except OSError:
                     pass  # dead waiter (crashed worker): drop its entry —
                     # the round must still complete for the live ones
@@ -1851,6 +2217,14 @@ class GeoPSServer:
                     # are idempotently ACKed from here on
                     if reply_to[2] is not None:
                         self._seen_pushes[reply_to[2]] = True
+                    req0 = reply_to[1]
+                    r0 = req0.meta.get("round")
+                    if r0 is not None and req0.sender >= 0:
+                        # the parked push is durable only NOW, at
+                        # install: bump the sender's merged-round count
+                        # here (see the direct-apply branch)
+                        st.pushed[req0.sender] = max(
+                            st.pushed.get(req0.sender, 0), int(r0))
                     st.round += 1
                     self._journal_round(key, st)
                     if self.ts_sched is not None:
@@ -1904,6 +2278,11 @@ class GeoPSServer:
     def _handle_pull(self, conn, msg: Msg):
         self._m_pulls.inc()
         with self._lock:
+            self._load_pulls += 1
+            redirect = self._wrong_shard_reply_locked(msg.key)
+            if redirect is not None:
+                self._reply(conn, msg, redirect)
+                return
             st = self._store.get(msg.key)
             if st is None:
                 self._reply(conn, msg, Msg(MsgType.ERROR,
@@ -1940,17 +2319,27 @@ class GeoPSServer:
                 f"ServerPull:{msg.key}", "kvstore",
                 args={"key": msg.key, "round_id": st.round,
                       "sender": msg.sender})
-            self._reply_pull_value(conn, msg, msg.key, val)
+            self._reply_pull_value(conn, msg, msg.key, val,
+                                   pushed=st.pushed.get(msg.sender, 0))
 
-    def _reply_pull_value(self, conn, req: Msg, key: str, val):
+    def _reply_pull_value(self, conn, req: Msg, key: str, val,
+                          pushed: Optional[int] = None):
         """Answer a PULL: whole tensor directly, or — when the request
         opted into P3 pull chunking and the tensor is big — as
         priority-tagged chunks through the connection's priority send
         queue (reference P3_ZPull slicing the reply the same way the
-        push side slices, kv_app.h:246-306)."""
+        push side slices, kv_app.h:246-306).
+
+        ``pushed`` is the requester's merged-round count at reply time
+        (journaled write-ahead of this reply): the proof the client's
+        session-resume layer needs to release its retained re-push
+        frames for rounds <= it — a reply alone proves nothing about a
+        push pipelined AFTER the pull was issued."""
         ce = req.meta.get("p3_chunk_elems")
         if not ce or val.size <= int(ce):
             reply = Msg(MsgType.PULL_REPLY, key=key, array=val)
+            if pushed is not None:
+                reply.meta["pushed"] = int(pushed)
             self._reply(conn, req, reply)
             return
         ce = int(ce)
@@ -1968,7 +2357,9 @@ class GeoPSServer:
             rep = Msg(MsgType.PULL_REPLY, key=key,
                       meta={"chunk": i, "num_chunks": num, "start": i * ce,
                             "n_total": n, "shape": list(val.shape),
-                            "gen": gen},
+                            "gen": gen,
+                            **({} if pushed is None
+                               else {"pushed": int(pushed)})},
                       array=flat[i * ce:(i + 1) * ce])
             if rid is not None:
                 rep.meta["rid"] = rid

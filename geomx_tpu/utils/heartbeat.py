@@ -37,21 +37,30 @@ class HeartbeatMonitor:
         with self._lock:
             self._last.pop(node_id, None)
 
+    def _snapshot(self) -> List[tuple]:
+        """Copy the beat table under the lock, WITHOUT evaluating it:
+        the dead/alive sweeps run over the snapshot outside the lock, so
+        a 32-party ``/healthz`` or ``num_dead_nodes`` scan can never
+        stall concurrent ``heartbeat()``/``register()`` RPCs behind an
+        O(N) pass (they share this lock)."""
+        with self._lock:
+            return list(self._last.items())
+
     def dead_nodes(self, timeout_s: Optional[float] = None) -> List[int]:
         """Nodes silent for longer than the timeout
         (reference GetDeadNodes(t))."""
         t = timeout_s if timeout_s is not None else self.timeout_s
+        snap = self._snapshot()
         now = time.monotonic()
-        with self._lock:
-            return sorted(n for n, ts in self._last.items() if now - ts > t)
+        return sorted(n for n, ts in snap if now - ts > t)
 
     def alive_nodes(self, timeout_s: Optional[float] = None) -> List[int]:
         """Complement of dead_nodes over the registered set — what the
         PartyLivenessController folds into a live-party mask."""
         t = timeout_s if timeout_s is not None else self.timeout_s
+        snap = self._snapshot()
         now = time.monotonic()
-        with self._lock:
-            return sorted(n for n, ts in self._last.items() if now - ts <= t)
+        return sorted(n for n, ts in snap if now - ts <= t)
 
     @property
     def num_dead_nodes(self) -> int:

@@ -89,10 +89,27 @@ def test_durable_store_torn_tail_physically_truncated(tmp_path):
     assert [r["n"] for r in recs] == [1, 2]  # nothing silently lost
 
 
-def test_reconnect_rejects_p3_chunking_loudly():
-    with pytest.raises(ValueError, match="P3 push chunking"):
-        GeoPSClient(("127.0.0.1", 1), sender_id=0, reconnect=True,
-                    p3_slice_elems=128)
+def test_reconnect_composes_with_p3_chunking_retaining_chunk_set():
+    """PR 10 rejected reconnect+P3 loudly (the re-push retained only
+    whole-tensor frames).  PR 11 retains a chunked round's FULL clean
+    chunk set instead — construction succeeds and the retained entry
+    holds every chunk frame (the mid-round restart replay is proven in
+    tests/test_manyparty.py + the real-SIGKILL test in
+    tests/test_recovery.py)."""
+    import numpy as np
+
+    from geomx_tpu.service import GeoPSServer
+    srv = GeoPSServer(num_workers=2, mode="sync", accumulate=True).start()
+    c = GeoPSClient(("127.0.0.1", srv.port), sender_id=0,
+                    reconnect=True, p3_slice_elems=16)
+    try:
+        c.init("w", np.zeros(100, np.float32))
+        c.push("w", np.ones(100, np.float32))   # 100 > 16: chunked
+        rnd, frames, _prio = c._last_push["w"]
+        assert rnd == 1 and len(frames) > 1     # the whole chunk set
+    finally:
+        c.close()
+        srv.stop(forward=False)
 
 
 def test_durable_store_compaction_covers_journal(tmp_path):

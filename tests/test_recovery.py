@@ -84,6 +84,57 @@ def test_killed_server_process_resumes_mid_round(tmp_path):
                 p.wait(timeout=10)
 
 
+def test_killed_server_process_resumes_p3_chunked_round(tmp_path):
+    """P3 priority transport + GEOMX_RECONNECT through a REAL process
+    death: the server child is SIGKILLed while worker 0's round-2 push
+    — sliced into priority-tagged chunks — is merged in memory only.
+    The replacement process replays the journal; the session-resume
+    handshake re-pushes the retained chunk SET (not a whole-tensor
+    frame), the server reassembles, and the round finishes with the
+    exact aggregate — the acceptance test that replaced PR 10's loud
+    reconnect+P3 rejection."""
+    import signal
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    proc = _spawn_server(port, str(tmp_path))
+    proc2 = None
+    ca = cb = None
+    try:
+        ca = GeoPSClient(("127.0.0.1", port), sender_id=0,
+                         reconnect=True, p3_slice_elems=16)
+        cb = GeoPSClient(("127.0.0.1", port), sender_id=1,
+                         reconnect=True, p3_slice_elems=16)
+        n = 100   # > 16 elems: every push is a chunk set
+        for c in (ca, cb):
+            c.init("w", np.zeros(n, np.float32))
+        ca.push("w", np.full(n, 1.0, np.float32))
+        cb.push("w", np.full(n, 2.0, np.float32))
+        assert np.allclose(ca.pull("w"), 3.0)      # round 1 durable
+        ca.push("w", np.full(n, 5.0, np.float32))  # round 2 chunks
+        assert len(ca._last_push["w"][1]) > 1      # chunk-set retained
+        import time
+        time.sleep(0.3)                            # merged (memory only)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc2 = _spawn_server(port, str(tmp_path))
+        cb.push("w", np.full(n, 2.0, np.float32))  # round 2, worker 1
+        assert np.allclose(cb.pull("w", timeout=60.0), 10.0)  # 3+5+2
+        assert np.allclose(ca.pull("w", timeout=60.0), 10.0)
+        ca.stop_server()
+    finally:
+        for c in (ca, cb):
+            if c is not None:
+                c.close()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
 def test_worker_restart_resumes_job():
     """Kill worker 1 mid-run; a restarted incarnation re-registers,
     recovers its progress, finishes the job; the aggregate is exact."""

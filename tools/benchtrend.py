@@ -6,10 +6,14 @@ The repo carries its own measurement history — ``BENCH_r*.json``
 captures), ``MULTICHIP_r*.json`` (the 8-device dryrun matrix),
 ``CONTROL_r*.json`` (the ``--compare-control`` chaos-replay
 acceptance: its three boolean gates plus the controller's
-time-to-loss-target, lower is better) and ``RECOVERY_r*.json`` (the
+time-to-loss-target, lower is better), ``RECOVERY_r*.json`` (the
 ``--compare-recovery`` host-plane kill/restart acceptance: its
 bit-exactness/restart/corruption boolean gates plus the recovery
-stall, lower is better).
+stall, lower is better) and ``MANYPARTY_r*.json`` (the
+``--compare-manyparty`` sharded-global-tier acceptance: bit-exactness /
+zero-lost-rounds / stall-bounded / failover / rebalance booleans plus
+the merge-throughput scaling ratio over shard count, higher is
+better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
 until a human re-read the numbers.  This tool makes the trajectory a
@@ -59,6 +63,7 @@ DIRECTION = {
     "step_time_ms": "down",
     "time_to_target_s": "down",
     "vs_baseline": "up",
+    "merge_throughput_scaling": "up",
 }
 
 
@@ -111,6 +116,23 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
         # recovery time is gated through the recovery_stall_bounded
         # boolean above — the raw sub-second stall is too noisy for a
         # relative band and would flake the gate
+        return out
+    if rec.get("mode") == "compare_manyparty":  # MANYPARTY_r*
+        for gate in ("ok", "params_bit_exact", "zero_lost_rounds",
+                     "shard_restarted", "failover_performed",
+                     "map_version_bumped", "corrupt_crc_nonzero",
+                     "stall_bounded", "rebalance_applied",
+                     "throughput_scales"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        thr = rec.get("throughput")
+        if isinstance(thr, dict) and isinstance(
+                thr.get("scaling"), (int, float)):
+            # the ratio is machine-sensitive (core count); the band
+            # still catches a collapse back toward 1.0
+            out["merge_throughput_scaling"] = float(thr["scaling"])
+        # the raw stall is gated through stall_bounded — like the
+        # RECOVERY series, the sub-minute absolute would flake a band
         return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
@@ -210,7 +232,7 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
         patterns: Optional[List[str]] = None) -> dict:
     patterns = patterns or ["BENCH_CAPTURED_r*.json", "BENCH_r*.json",
                             "MULTICHIP_r*.json", "CONTROL_r*.json",
-                            "RECOVERY_r*.json"]
+                            "RECOVERY_r*.json", "MANYPARTY_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     unreadable: List[str] = []
     for pat in patterns:
