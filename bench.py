@@ -97,6 +97,21 @@ Micro-modes:
       tier (exact-once merges across the key migration) and a merge-
       throughput curve over shard count that must scale.  Pure
       service plane (sockets + numpy) — no jax mesh, CPU.
+  bench.py --compare-sparseagg [--model=resnet20] [--steps=5]
+           [--batch=24] [--wan-mbps=200] [--rtt-ms=30]
+      One JSON line for compressed-domain aggregation (GEOMX_SPARSE_AGG,
+      compression/sparseagg.py, docs/performance.md): on a 3-party CPU
+      mesh, GX-PURITY-001 audits the FULL merged bsc path clean (no
+      dense-size operand between compress and final decompress,
+      including the ZeRO shard composition) while the dense_merge
+      corpus entry stays flagged; the owner-routed merge is
+      bit-identical between the jnp and Pallas paths; the host-plane
+      sorted-sender sparse merge is bit-exact across shuffled push
+      arrival orders (pulls reply sparse); fp16/2bit trace to ONE
+      quantized-lattice psum with no gather; and measured 3-party
+      training with the modeled WAN link gives bsc samples/sec >=
+      vanilla dense — reversing the BENCH_CAPTURED_r05 on-chip
+      regression at the multi-party topology.  CPU, no TPU needed.
   bench.py --audit [--model=mlp]
       One JSON line for the Graft Auditor (geomx_tpu/analysis/,
       docs/analysis.md): every green tier-1 step program (vanilla, bsc,
@@ -4437,6 +4452,367 @@ def compare_manyparty_main(argv):
     _emit(_compare_manyparty(**kwargs))
 
 
+# --------------------------------------------------------------------------
+# --compare-sparseagg: compressed-domain aggregation end to end
+# --------------------------------------------------------------------------
+
+
+def _sparseagg_dc_bit_parity(parties: int = 3, n: int = 8192,
+                             ratio: float = 0.01) -> dict:
+    """The owner-routed dc-tier merge must be BIT-identical between the
+    jnp reference and the Pallas (interpret) engine — same sort, same
+    combining tree, same final scatter (ops/merge_pallas.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from geomx_tpu.compression.bisparse import BiSparseCompressor
+    from geomx_tpu.parallel.collectives import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices()[:parties]), ("dc",))
+    rng = np.random.RandomState(11)
+    g = jnp.asarray(rng.standard_normal((parties, n)).astype(np.float32))
+
+    def run(comp):
+        def f(gs, us, vs):
+            out, (u2, v2) = comp.allreduce_leaf(
+                gs[0], (us[0], vs[0]), "dc", parties)
+            return out[None], u2[None], v2[None]
+
+        fn = shard_map_compat(f, mesh, in_specs=(P("dc"),) * 3,
+                              out_specs=(P("dc"),) * 3)
+        z = jnp.zeros((parties, n), jnp.float32)
+        return [np.asarray(a) for a in jax.jit(fn)(g, z, z)]
+
+    base = dict(ratio=ratio, select="sampled", min_sparse_size=1,
+                sparse_agg=True)
+    oj = run(BiSparseCompressor(fused=False, **base))
+    of = run(BiSparseCompressor(fused=True, fused_interpret=True, **base))
+    bit = all(np.array_equal(a, b) for a, b in zip(oj, of))
+    consistent = all(np.array_equal(oj[0][0], oj[0][p])
+                     for p in range(parties))
+    return {"merged_bit_exact_paths": bool(bit),
+            "result_identical_across_parties": bool(consistent),
+            "merged_nonzeros": int((oj[0][0] != 0).sum()),
+            "elems": n}
+
+
+def _sparseagg_server_orders(n: int = 4096, k: int = 96,
+                             orders: int = 3) -> dict:
+    """Host-plane sparse merge: shuffled push arrival orders must yield
+    bit-identical sparse-merged rounds (sorted-sender + sorted-index
+    fold, service/server.py), with the round pulled SPARSE."""
+    import numpy as np
+
+    from geomx_tpu.compression.sparseagg import encode_pairs_payload
+    from geomx_tpu.service.client import GeoPSClient
+    from geomx_tpu.service.server import GeoPSServer
+    from geomx_tpu.telemetry import get_registry
+
+    rng = np.random.RandomState(5)
+    payloads = {}
+    for s in range(3):
+        idx = rng.choice(n, k, replace=False).astype(np.int64)
+        vals = (rng.standard_normal(k) * 10.0 ** rng.randint(
+            -3, 6, size=k)).astype(np.float32)
+        payloads[s] = encode_pairs_payload(vals, idx)
+    meta = {"comp": "bsc", "n": n, "shape": [n]}
+    outs = []
+
+    def merges_total():
+        fam = get_registry().get("geomx_server_sparse_merges_total")
+        return sum(ch.value for _, ch in fam.children()) if fam else 0.0
+
+    before = merges_total()
+    order_perms = [(0, 1, 2), (2, 0, 1), (1, 2, 0)][:orders]
+    for perm in order_perms:
+        srv = GeoPSServer(num_workers=3, mode="sync").start()
+        cs = [GeoPSClient(("127.0.0.1", srv.port), sender_id=s)
+              for s in range(3)]
+        cs[0].init("w", np.zeros(n, np.float32))
+        for s in perm:
+            cs[s].push("w", payloads[s], meta=dict(meta))
+        outs.append(np.asarray(cs[0].pull("w")))
+        cs[0].stop_server()
+        for c in cs:
+            c.close()
+        srv.join(5)
+    bit = all(np.array_equal(outs[0], o) for o in outs[1:])
+    return {"merged_bit_exact_orders": bool(bit),
+            "server_sparse_merges": int(merges_total() - before),
+            "orders": len(order_perms)}
+
+
+def _sparseagg_lattice_structure(parties: int = 3) -> dict:
+    """fp16/2bit under the gate must trace to ONE integer-lattice psum
+    on the weight path and NO gather — the THC structure."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from geomx_tpu.analysis.core import walk_jaxpr
+    from geomx_tpu.analysis.passes import _GATHER_PRIMS
+    from geomx_tpu.compression.fp16 import FP16Compressor
+    from geomx_tpu.compression.twobit import TwoBitCompressor
+    from geomx_tpu.parallel.collectives import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices()[:parties]), ("dc",))
+    n = 4096
+    rng = np.random.RandomState(3)
+    g = rng.standard_normal((parties, n)).astype(np.float32)
+
+    def structure(comp, with_state):
+        def f(gs, ss):
+            st = ss[0] if with_state else ()
+            out, s2 = comp.allreduce_leaf(gs[0], st, "dc", parties)
+            s2 = s2[None] if with_state else gs[:0]
+            return out[None], s2
+
+        fn = shard_map_compat(f, mesh, in_specs=(P("dc"), P("dc")),
+                              out_specs=(P("dc"), P("dc")))
+        ss = jnp.zeros((parties, n), jnp.float32)
+        jx = jax.make_jaxpr(fn)(jnp.asarray(g), ss)
+        prims = [s.primitive for s in walk_jaxpr(jx)]
+        psum_int = 0
+        for site in walk_jaxpr(jx):
+            if site.primitive in ("psum", "psum2"):
+                dts = {str(v.aval.dtype) for v in site.eqn.invars
+                       if hasattr(v, "aval")}
+                if dts & {"int8", "int16", "int32"}:
+                    psum_int += 1
+        out_np = np.asarray(jax.jit(fn)(jnp.asarray(g), ss)[0])
+        return {"lattice_psums": psum_int,
+                "gathers": sum(1 for p in prims if p in _GATHER_PRIMS),
+                "finite": bool(np.isfinite(out_np).all()),
+                "max_err_vs_exact": float(
+                    np.max(np.abs(out_np[0] - _expected(comp, g)))),
+                }
+
+    def _expected(comp, g):
+        if isinstance(comp, FP16Compressor):
+            return g.sum(0)
+        thr = comp.threshold
+        codes = np.where(g >= thr, 1, np.where(g <= -thr, -1, 0))
+        return codes.sum(0) * thr
+
+    fp = structure(FP16Compressor(sparse_agg=True), with_state=False)
+    tb = structure(TwoBitCompressor(0.5, use_pallas=False,
+                                    sparse_agg=True), with_state=True)
+    scale_tol = 3.0 * float(np.abs(g).max()) * parties * parties / 32767.0
+    return {
+        "fp16": fp, "twobit": tb,
+        "fp16_lattice_psum": bool(fp["lattice_psums"] >= 1
+                                  and fp["gathers"] == 0
+                                  and fp["finite"]
+                                  and fp["max_err_vs_exact"] <= scale_tol),
+        "twobit_lattice_psum": bool(tb["lattice_psums"] >= 1
+                                    and tb["gathers"] == 0
+                                    and tb["max_err_vs_exact"] == 0.0),
+    }
+
+
+def _sparseagg_zero_parity(parties: int = 3, ratio: float = 0.02) -> dict:
+    """ZeRO composition: the shard-sized streams run the same
+    owner-routed merge — jnp vs Pallas paths bit-identical on
+    ``BucketedCompressor.allreduce_shards``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from geomx_tpu.compression import BucketedCompressor
+    from geomx_tpu.compression.bisparse import BiSparseCompressor
+    from geomx_tpu.parallel.collectives import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices()[:parties]), ("dc",))
+    rng = np.random.RandomState(17)
+    params = [jnp.asarray(rng.standard_normal(s).astype(np.float32))
+              for s in (3000, 1100)]
+    shardsW = 2
+
+    def run(comp):
+        bucketed = BucketedCompressor(comp, bucket_bytes=64 * 1024,
+                                      pad_to=128 * shardsW)
+        bk = bucketed.zero_bucketer(params)
+        shard_sizes = [s // shardsW for s in bk.bucket_sizes]
+        state = bucketed.init_shard_state(params, shardsW)
+        buckets = bk.flatten(params)
+        shards = [b[:s] for b, s in zip(buckets, shard_sizes)]
+
+        def f(sh, ss):
+            sh = [a[0] for a in sh]
+            s = jax.tree.map(lambda a: a[0], ss)
+            out, s2 = bucketed.allreduce_shards(sh, s, "dc", parties, bk)
+            return ([a[None] for a in out],
+                    jax.tree.map(lambda a: a[None], s2))
+
+        fn = shard_map_compat(f, mesh, in_specs=(P("dc"), P("dc")),
+                              out_specs=(P("dc"), P("dc")))
+
+        def stack(t):
+            return jax.tree.map(
+                lambda a: jnp.stack([jnp.asarray(a)] * parties), t)
+
+        out, s2 = jax.jit(fn)(stack(shards), stack(state))
+        return ([np.asarray(a) for a in jax.tree.leaves(out)]
+                + [np.asarray(a) for a in jax.tree.leaves(s2)])
+
+    base = dict(ratio=ratio, select="sampled", min_sparse_size=1,
+                sparse_agg=True)
+    oj = run(BiSparseCompressor(fused=False, **base))
+    of = run(BiSparseCompressor(fused=True, fused_interpret=True, **base))
+    bit = len(oj) == len(of) and all(
+        np.array_equal(a, b) for a, b in zip(oj, of))
+    return {"zero_shard_bit_exact_paths": bool(bit),
+            "zero_shards": shardsW}
+
+
+def _compare_sparseagg(model_name: str = "resnet20", steps: int = 5,
+                       batch: int = 24, wan_mbps: float = 200.0,
+                       rtt_ms: float = 30.0, ratio: float = 0.01):
+    """Compressed-domain aggregation acceptance (ISSUE 12) — module
+    docstring under --compare-sparseagg."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from geomx_tpu.analysis.corpus import run_corpus
+    from geomx_tpu.analysis.passes import (audit_compressed_path,
+                                           audit_zero_compressed_path)
+    from geomx_tpu.compression import BucketedCompressor, get_compressor
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+
+    parties = 3
+    devs = jax.devices()
+    if len(devs) < 4:
+        # 3 for the multi-party meshes + a 4-wide axis for the corpus
+        # replay's scatter_wire_lie entry
+        raise RuntimeError(
+            "compare-sparseagg needs >= 4 devices (3-party meshes + the "
+            "4-wide corpus replay; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=4)")
+    out = {"mode": "compare_sparseagg", "model": model_name,
+           "parties": parties, "steps": steps, "batch": batch,
+           "wan_mbps": wan_mbps, "rtt_ms": rtt_ms, "ratio": ratio,
+           "device": {"device_kind": devs[0].device_kind,
+                      "n_devices": len(devs)}}
+
+    # -- (a) purity: the FULL merged path, replicated and ZeRO-shard ------
+    model = get_model(model_name, num_classes=10)
+    sample = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    params = jax.jit(lambda r, x: model.init(r, x, train=False))(
+        jax.random.PRNGKey(0), sample)["params"]
+    sa_spec = f"bsc,{ratio},select=exact,sparse_agg=1,fused=0"
+    bucketed = BucketedCompressor(get_compressor(sa_spec))
+    findings = audit_compressed_path(bucketed, params,
+                                     num_parties=parties)
+    zbucketed = BucketedCompressor(get_compressor(sa_spec), pad_to=256)
+    zfindings = audit_zero_compressed_path(zbucketed, params, 2,
+                                           num_parties=parties)
+    corpus = run_corpus()
+    out["purity"] = {
+        "findings": [f.message for f in findings],
+        "zero_findings": [f.message for f in zfindings],
+        "purity_clean": not findings,
+        "zero_shard_purity_clean": not zfindings,
+        "dense_merge_flagged": bool(corpus["dense_merge"]["flagged"]),
+    }
+
+    # -- (b) bit-exactness: engines and arrival orders --------------------
+    out["dc_parity"] = _sparseagg_dc_bit_parity(parties=parties)
+    out["server_merge"] = _sparseagg_server_orders()
+    out["lattice"] = _sparseagg_lattice_structure(parties=parties)
+    out["zero_parity"] = _sparseagg_zero_parity(parties=parties)
+
+    # -- (c) samples/sec at the multi-party topology ----------------------
+    topo = HiPSTopology(num_parties=parties, workers_per_party=1)
+    local_b = max(1, batch // parties)
+    rng = np.random.RandomState(0)
+    xs = (rng.rand(steps + 2, parties, 1, local_b, 32, 32, 3)
+          * 255).astype(np.uint8)
+    ys = rng.randint(0, 10, size=(steps + 2, parties, 1,
+                                  local_b)).astype(np.int32)
+
+    def measure(comp_spec):
+        cfg = GeoConfig(num_parties=parties, workers_per_party=1,
+                        compression=comp_spec)
+        tr = Trainer(get_model(model_name, num_classes=10), topo,
+                     optax.sgd(0.1, momentum=0.9),
+                     sync=get_sync_algorithm(cfg), config=cfg)
+        st = tr.init_state(jax.random.PRNGKey(0), xs[0, 0, 0, :2])
+        sharding = topo.batch_sharding(tr.mesh)
+        times = []
+        for s in range(steps + 2):
+            xb = jax.device_put(xs[s], sharding)
+            yb = jax.device_put(ys[s], sharding)
+            t0 = time.perf_counter()
+            st, _m = tr.train_step(st, xb, yb)
+            jax.block_until_ready(st.step)
+            times.append(time.perf_counter() - t0)
+        compute_s = float(np.median(times[2:]))
+        wire = int(tr.sync.dc_compressor.wire_bytes(st.params))
+        # deterministic multi-party WAN model: the dc payload crosses a
+        # wan_mbps link once per step plus one RTT (identical model for
+        # every config — only the payload differs)
+        wan_s = wire * 8.0 / (wan_mbps * 1e6) + rtt_ms / 1e3
+        step_s = compute_s + wan_s
+        return {"compute_step_ms": compute_s * 1e3,
+                "modeled_wan_ms": wan_s * 1e3,
+                "step_time_ms": step_s * 1e3,
+                "wire_bytes_per_step": wire,
+                "samples_per_sec": parties * local_b / step_s,
+                "on_chip_samples_per_sec": parties * local_b / compute_s}
+
+    sa_train_spec = f"bsc,{ratio},sparse_agg=1"
+    out["configs"] = {
+        "vanilla": measure("none"),
+        "bsc_sparseagg": measure(sa_train_spec),
+    }
+    dense = out["configs"]["vanilla"]["samples_per_sec"]
+    sparse = out["configs"]["bsc_sparseagg"]["samples_per_sec"]
+    out["sparse_vs_dense"] = sparse / dense if dense else 0.0
+    out["sparse_beats_dense"] = bool(sparse >= dense)
+
+    gates = ("purity_clean", "zero_shard_purity_clean",
+             "dense_merge_flagged")
+    out["ok"] = bool(
+        all(out["purity"][g] for g in gates)
+        and out["dc_parity"]["merged_bit_exact_paths"]
+        and out["dc_parity"]["result_identical_across_parties"]
+        and out["server_merge"]["merged_bit_exact_orders"]
+        and out["server_merge"]["server_sparse_merges"] >= 3
+        and out["lattice"]["fp16_lattice_psum"]
+        and out["lattice"]["twobit_lattice_psum"]
+        and out["zero_parity"]["zero_shard_bit_exact_paths"]
+        and out["sparse_beats_dense"])
+    return out
+
+
+def compare_sparseagg_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--steps="):
+            kwargs["steps"] = int(a.split("=", 1)[1])
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--wan-mbps="):
+            kwargs["wan_mbps"] = float(a.split("=", 1)[1])
+        elif a.startswith("--rtt-ms="):
+            kwargs["rtt_ms"] = float(a.split("=", 1)[1])
+        elif a.startswith("--ratio="):
+            kwargs["ratio"] = float(a.split("=", 1)[1])
+    _emit(_compare_sparseagg(**kwargs))
+
+
 def main():
     if "--compare-kernels" in sys.argv:
         # kernel micro-mode: in-process, single device is enough (no
@@ -4492,6 +4868,18 @@ def main():
         # host-plane recovery acceptance: pure service-plane (sockets +
         # numpy), no jax mesh — runs anywhere in seconds
         compare_recovery_main(sys.argv[1:])
+    elif "--compare-sparseagg" in sys.argv:
+        # compressed-domain aggregation acceptance: in-process on the
+        # CPU backend, 4 virtual devices — the training/parity meshes
+        # use 3 (the multi-party topology the ISSUE's perf gate names);
+        # the corpus replay's scatter_wire_lie entry needs a 4-wide axis
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4").strip()
+        compare_sparseagg_main(sys.argv[1:])
     elif "--compare-manyparty" in sys.argv:
         # many-party sharded-global-tier acceptance: pure service-plane
         # (sockets + numpy, 16+ worker threads), no jax mesh

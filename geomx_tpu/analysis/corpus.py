@@ -23,6 +23,10 @@ Entries (name -> expected rule):
   shard x (N-1) gather the chips actually send
 - ``dense_compressed_path``  -> GX-PURITY-001       a "compressed" path
   that decompresses to dense BEFORE the collective
+- ``dense_merge``            -> GX-PURITY-001       a compressed path
+  whose wire payloads are all sparse but whose MERGE densifies each
+  party's stream after the gather and sums the dense copies — the
+  post-collective side of the purity rule (merge-without-densify)
 """
 
 from __future__ import annotations
@@ -205,6 +209,43 @@ def _dense_compressed_path() -> List[Finding]:
     return audit_compressed_path(comp, jnp.zeros((8192,), jnp.float32))
 
 
+def _dense_merge() -> List[Finding]:
+    """Every wire payload is compressed — the gather carries (value,
+    index) pairs — but the merge decompresses EACH party's pairs into
+    its own dense buffer and sums the dense copies: one dense scatter
+    per party after the final collective, where the compressed-domain
+    merge pays exactly one (the final decompress).  The post-collective
+    side of GX-PURITY-001 flags the second scatter."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from geomx_tpu.analysis.passes import audit_compressed_path
+    from geomx_tpu.compression.bisparse import BiSparseCompressor
+
+    class DenseMergeBSC(BiSparseCompressor):
+        name = "bsc_dense_merge"
+
+        def allreduce_leaf(self, g, state, axis_name, axis_size):
+            n = g.size
+            if not self._sparse_eligible(n):
+                return lax.psum(g, axis_name), state
+            u, v = state
+            vals, idx, u, v = self.compress(
+                g.reshape(-1).astype(jnp.float32), u.reshape(-1),
+                v.reshape(-1))
+            all_vals = lax.all_gather(vals, axis_name)  # sparse wire: fine
+            all_idx = lax.all_gather(idx, axis_name)
+            out = jnp.zeros((n,), jnp.float32)
+            for p in range(axis_size):   # the defect: per-party densify
+                out = out + self.decompress(all_vals[p], all_idx[p], n)
+            return (out.reshape(g.shape).astype(g.dtype),
+                    (u.reshape(g.shape), v.reshape(g.shape)))
+
+    comp = DenseMergeBSC(ratio=0.01, select="exact", min_sparse_size=1,
+                         fused=False, sparse_agg=False)
+    return audit_compressed_path(comp, jnp.zeros((8192,), jnp.float32))
+
+
 CORPUS = (
     CorpusEntry("divergent_collectives", "GX-COLLECTIVE-001",
                 _divergent_collectives),
@@ -214,6 +255,7 @@ CORPUS = (
     CorpusEntry("scatter_wire_lie", "GX-DTYPE-002", _scatter_wire_lie),
     CorpusEntry("dense_compressed_path", "GX-PURITY-001",
                 _dense_compressed_path),
+    CorpusEntry("dense_merge", "GX-PURITY-001", _dense_merge),
 )
 
 

@@ -552,15 +552,35 @@ def audit_wire_accounting(compressor, params, num_parties: int = 2,
 # compressed-path purity
 # ---------------------------------------------------------------------------
 
+# scatter-family prims: the ops that MATERIALIZE a dense buffer from a
+# sparse stream (the decompress).  The post-collective merge rule counts
+# these — sort/cumsum stay out (they appear legitimately inside a later
+# bucket's pre-collective select in multi-bucket programs)
+_DENSIFY_PRIMS = frozenset({
+    "scatter", "scatter-add", "scatter-mul", "scatter-min", "scatter-max"})
+
+
 class PurityPass(AuditPass):
-    """GX-PURITY-001: on a compressed dc path, every wire payload must
-    be compressed — a collective operand whose byte size reaches
-    ``ctx.dense_bytes`` (the dense fp32 footprint of the largest
-    bucket/leaf the compressor covers) means a dense intermediate
-    crossed select/pack and the collective (the decompress-before-
-    collective regression class).  Reusable against any bucket size and
-    both the jnp and fused paths: the fused kernels are opaque calls, so
-    only genuinely wire-bound avals are inspected."""
+    """GX-PURITY-001, both sides of the compressed dc path:
+
+    - *compress-before-collective* (the original rule): a collective
+      operand whose byte size reaches ``ctx.dense_bytes`` (the dense
+      fp32 footprint of the largest bucket/leaf the compressor covers)
+      means a dense intermediate crossed select/pack and the collective
+      (the decompress-before-collective regression class);
+    - *merge-without-densify* (the post-collective side): after the
+      FINAL collective, the merged sparse stream may densify at most
+      ``ctx.extras["allowed_dense_after_collective"]`` times (default
+      1 — the single final decompress).  A per-party densify-then-sum
+      merge materializes one dense scatter per party and is flagged
+      here even though its wire payloads were all compressed.  The
+      anchor is the last collective (not every collective) so a later
+      bucket's pre-collective select chain in a multi-bucket program
+      never reads as "post-collective" of an earlier bucket.
+
+    Reusable against any bucket size and both the jnp and fused paths:
+    the fused kernels are opaque calls, so only genuinely wire-bound
+    avals and true XLA scatters are inspected."""
 
     rule_id = "GX-PURITY-001"
 
@@ -569,9 +589,12 @@ class PurityPass(AuditPass):
         if not dense:
             return []
         findings: List[Finding] = []
-        for site in walk_jaxpr(jaxpr):
+        sites = list(walk_jaxpr(jaxpr))
+        last_collective = -1
+        for i, site in enumerate(sites):
             if site.primitive not in COLLECTIVE_PRIMS:
                 continue
+            last_collective = i
             for v in site.eqn.invars:
                 if not hasattr(v, "aval"):
                     continue
@@ -586,6 +609,29 @@ class PurityPass(AuditPass):
                         "and the collective",
                         site=site,
                         detail={"bytes": nbytes, "dense_bytes": dense,
+                                "shape": list(shape), "dtype": dtype}))
+        if last_collective < 0:
+            return findings
+        allowed = int(ctx.extras.get("allowed_dense_after_collective", 1))
+        densifies = 0
+        for site in sites[last_collective + 1:]:
+            if site.primitive not in _DENSIFY_PRIMS:
+                continue
+            for v in site.eqn.outvars:
+                if not hasattr(v, "aval") or aval_bytes(v.aval) < dense:
+                    continue
+                densifies += 1
+                if densifies > allowed:
+                    shape, dtype = aval_sig(v.aval)
+                    findings.append(self.finding(
+                        f"{site.primitive} materializes dense output "
+                        f"#{densifies} ({shape} {dtype}) after the final "
+                        f"collective (allowed: {allowed}) — the merge "
+                        "densifies per party instead of combining in "
+                        "the compressed domain",
+                        site=site,
+                        detail={"densify_count": densifies,
+                                "allowed": allowed,
                                 "shape": list(shape), "dtype": dtype}))
         return findings
 

@@ -112,11 +112,14 @@ def _parse_int(v: str) -> int:
 # constructor accepts that the positional form cannot express.
 _SPEC_GRAMMAR = {
     "none": ([], {}),
-    "fp16": ([], {"bf16": _parse_bool}),
-    "2bit": (["threshold"], {"threshold": float}),
+    "fp16": ([], {"bf16": _parse_bool, "sparse_agg": _parse_bool}),
+    "2bit": (["threshold"], {"threshold": float,
+                             "sparse_agg": _parse_bool}),
     "bsc": (["ratio"], {"ratio": float, "select": str,
                         "min_sparse_size": _parse_int,
-                        "approx": _parse_bool, "fused": _parse_bool}),
+                        "approx": _parse_bool, "fused": _parse_bool,
+                        "sparse_agg": _parse_bool,
+                        "sparse_agg_parties": _parse_int}),
     "mpq": (["ratio", "size_lower_bound"],
             {"ratio": float, "size_lower_bound": _parse_int,
              "bf16": _parse_bool, "approx": _parse_bool}),

@@ -44,6 +44,26 @@ from geomx_tpu.compression.base import Compressor
 MOMENTUM = 0.9  # hardcoded in the reference (gc.cc:200)
 
 
+def _note_dense_fallback(n: int, min_sparse_size: int) -> None:
+    """The silent "too small to sparsify, send dense fp32" decision,
+    made observable: one counter bump + one debug line per TRACE of a
+    falling-back leaf/bucket (the decision is static per shape — a
+    per-step count would just multiply it by the step count), so MPQ /
+    Graft Pilot tuning can see when sparsification is being bypassed."""
+    import logging
+
+    from geomx_tpu.telemetry import get_registry
+    # graftlint: disable=GXL004 — per-trace (static-shape) accounting
+    get_registry().counter(
+        "geomx_bsc_dense_fallback_total",
+        "BSC leaves/buckets sent dense fp32 instead of sparsified",
+        ("reason",)).labels("below_min_sparse_size").inc()
+    logging.getLogger("geomx_tpu.compression").debug(
+        "bsc dense fallback: leaf of %d elements < min_sparse_size=%d "
+        "— 2k-pair payload would approach dense size, sending dense fp32",
+        n, min_sparse_size)
+
+
 class BiSparseCompressor(Compressor):
     name = "bsc"
 
@@ -51,7 +71,9 @@ class BiSparseCompressor(Compressor):
                  min_sparse_size: int = 1024,
                  select: "str | None" = None,
                  fused: "bool | None" = None,
-                 fused_interpret: bool = False):
+                 fused_interpret: bool = False,
+                 sparse_agg: "bool | None" = None,
+                 sparse_agg_parties: "int | None" = None):
         """``select``: "exact" (lax.top_k), "approx" (lax.approx_max_k),
         or "sampled" (the reference's sampled-boundary scan,
         ops/sampled_topk.py).  Default: GEOMX_BSC_SELECT if set, else —
@@ -66,7 +88,23 @@ class BiSparseCompressor(Compressor):
         selections keep their lax.top_k forms) and the scatter-add
         decompress for every selection.  Default: on when the backend is
         TPU and GEOMX_FUSED_KERNELS != 0.  ``fused_interpret`` runs the
-        kernels in Pallas interpret mode (CPU parity tests)."""
+        kernels in Pallas interpret mode (CPU parity tests).
+
+        ``sparse_agg``: merge in the compressed domain — the
+        owner-routed sparse allreduce of compression/sparseagg.py
+        (route pairs to index-range owners over ``all_to_all``, merge
+        by sorted-index segment sum, re-select per owner, one final
+        decompress) instead of the all-gather + dense scatter-add
+        chain.  Per-chip wire and merge work become O(k) instead of
+        O(k * parties); the merged result carries the pull-side
+        re-selection budget (``GEOMX_SPARSE_AGG_PULL_SLACK`` * k pairs
+        globally), with push-routing overflow reinjected into the
+        error-feedback velocity.  Default: ``GEOMX_SPARSE_AGG``
+        (off).  ``sparse_agg_parties`` pins the dc-axis width the
+        owner-routed path's wire accounting assumes; without it the
+        width of the most recent traced allreduce is used (2 before
+        any trace) — pass it when calling ``wire_bytes`` before the
+        first trace or when one instance serves multiple widths."""
         import os
         if ratio <= 0:
             raise ValueError("threshold must be greater than 0")
@@ -101,6 +139,17 @@ class BiSparseCompressor(Compressor):
         # tensors smaller than this aren't worth sparsifying: 2*k payload
         # would approach the dense size; send dense fp32 instead
         self.min_sparse_size = int(min_sparse_size)
+        if sparse_agg is None:
+            from geomx_tpu.compression.sparseagg import sparse_agg_enabled
+            sparse_agg = sparse_agg_enabled()
+        self.sparse_agg = bool(sparse_agg)
+        # dc-axis width the owner-routed wire accounting assumes: the
+        # explicit pin when given, else the width of the last traced
+        # allreduce (2 before any trace) — the payload depends on the
+        # party count
+        self.sparse_agg_parties = None if sparse_agg_parties is None \
+            else int(sparse_agg_parties)
+        self._wire_axis_size = self.sparse_agg_parties or 2
 
     def k_for(self, n: int) -> int:
         return max(1, int(math.ceil(n * self.ratio)))
@@ -229,6 +278,7 @@ class BiSparseCompressor(Compressor):
                        axis_size: int) -> Tuple[jax.Array, Any]:
         shape, dtype, n = g.shape, g.dtype, g.size
         if not self._sparse_eligible(n):
+            _note_dense_fallback(n, self.min_sparse_size)
             if axis_size == 1:
                 return g, state
             return lax.psum(g, axis_name), state
@@ -237,6 +287,20 @@ class BiSparseCompressor(Compressor):
             g.reshape(-1).astype(jnp.float32), u.reshape(-1), v.reshape(-1))
         if axis_size == 1:
             out = self.decompress(vals, idx, n)
+        elif self.sparse_agg:
+            # compressed-domain merge (compression/sparseagg.py): route
+            # pairs to their index-range owners, merge by sorted-index
+            # segment sum, re-select per owner, decompress ONCE.  The
+            # routing overflow (pairs past a destination's slot budget)
+            # reinjects into the error-feedback velocity so its mass
+            # retries next round instead of vanishing.
+            from geomx_tpu.compression.sparseagg import sparse_allreduce
+            if self.sparse_agg_parties is None:
+                self._wire_axis_size = int(axis_size)
+            out, v = sparse_allreduce(
+                vals, idx, n, axis_name, axis_size, self.decompress,
+                ef_buffer=v, merge_fused=self.fused,
+                interpret=self.fused_interpret)
         else:
             # the wire transfer: 2k floats per party over the dc tier
             all_vals = lax.all_gather(vals, axis_name).reshape(-1)
@@ -249,4 +313,7 @@ class BiSparseCompressor(Compressor):
         n = leaf.size
         if not self._sparse_eligible(n):
             return n * 4
+        if self.sparse_agg:
+            from geomx_tpu.compression.sparseagg import sparse_wire_bytes
+            return sparse_wire_bytes(self.k_for(n), self._wire_axis_size)
         return 2 * self.k_for(n) * 4

@@ -25,14 +25,31 @@ from geomx_tpu.compression.base import Compressor
 class FP16Compressor(Compressor):
     name = "fp16"
 
-    def __init__(self, bf16: bool = False):
+    def __init__(self, bf16: bool = False,
+                 sparse_agg: "bool | None" = None):
+        """``sparse_agg`` (default ``GEOMX_SPARSE_AGG``): sum in the
+        quantized lattice per THC (compression/sparseagg.py) — one
+        shared scale negotiated across the axis (scalar pmax), int16
+        codes with party-count headroom summed EXACTLY by the
+        collective, one dequantize.  Same 2-byte wire; the [axis, n]
+        gathered-then-upcast per-party intermediate disappears."""
         self.wire_dtype = jnp.bfloat16 if bf16 else jnp.float16
+        if sparse_agg is None:
+            from geomx_tpu.compression.sparseagg import sparse_agg_enabled
+            sparse_agg = sparse_agg_enabled()
+        self.sparse_agg = bool(sparse_agg)
 
     def allreduce_leaf(self, g: jax.Array, state: Any, axis_name: str,
                        axis_size: int) -> Tuple[jax.Array, Any]:
         wire = g.astype(self.wire_dtype)
         if axis_size == 1:
             return wire.astype(g.dtype), state
+        if self.sparse_agg:
+            from geomx_tpu.compression.sparseagg import \
+                lattice_allreduce_fp16
+            flat = lattice_allreduce_fp16(g.reshape(-1), axis_name,
+                                          axis_size)
+            return flat.reshape(g.shape).astype(g.dtype), state
         gathered = lax.all_gather(wire, axis_name)        # [axis, *shape] 16-bit
         total = jnp.sum(gathered.astype(g.dtype), axis=0)  # fp32 accumulate
         return total, state

@@ -59,7 +59,8 @@ class TwoBitCompressor(Compressor):
 
     def __init__(self, threshold: float = 0.5,
                  use_pallas: "bool | None" = None,
-                 pallas_interpret: bool = False):
+                 pallas_interpret: bool = False,
+                 sparse_agg: "bool | None" = None):
         """``use_pallas`` switches quantize/dequantize to the fused Pallas
         kernels in geomx_tpu.ops (one HBM pass; TPU-native path).  The wire
         format differs between the paths but both are self-inverse, and the
@@ -67,7 +68,15 @@ class TwoBitCompressor(Compressor):
         fused kernel measures ~15x faster than the unfused jnp graph at
         4M elements — BENCH_r04 microbench), jnp elsewhere (Pallas
         interpret mode is far slower than XLA:CPU).  GEOMX_TWOBIT_PALLAS=0
-        opts out."""
+        opts out.
+
+        ``sparse_agg`` (default ``GEOMX_SPARSE_AGG``): sum in the
+        quantized lattice per THC (compression/sparseagg.py) — the
+        static ±threshold grid IS the shared scale, so the per-party
+        ±1 sign codes psum EXACTLY as int8 and one scale lands fp32.
+        Wire: n int8 bytes instead of the packed n/4 (4x the packed
+        payload, but the merge is one integer collective with no
+        [axis, n] per-party unpack intermediates — the THC trade)."""
         if threshold <= 0:
             raise ValueError("threshold must be greater than 0")  # gc.cc:50
         self.threshold = float(threshold)
@@ -76,6 +85,10 @@ class TwoBitCompressor(Compressor):
             use_pallas = default_on_tpu("GEOMX_TWOBIT_PALLAS")
         self.use_pallas = use_pallas
         self.pallas_interpret = pallas_interpret
+        if sparse_agg is None:
+            from geomx_tpu.compression.sparseagg import sparse_agg_enabled
+            sparse_agg = sparse_agg_enabled()
+        self.sparse_agg = bool(sparse_agg)
 
     def init_leaf_state(self, leaf: jax.Array) -> Any:
         # error-feedback residual, same shape as the gradient
@@ -95,6 +108,9 @@ class TwoBitCompressor(Compressor):
 
     def allreduce_leaf(self, g: jax.Array, residual: Any, axis_name: str,
                        axis_size: int) -> Tuple[jax.Array, Any]:
+        if self.sparse_agg and axis_size > 1:
+            return self._allreduce_lattice(g, residual, axis_name,
+                                           axis_size)
         if self.use_pallas:
             return self._allreduce_pallas(g, residual, axis_name, axis_size)
         shape, dtype = g.shape, g.dtype
@@ -111,6 +127,26 @@ class TwoBitCompressor(Compressor):
             signs = jnp.where(codes == 1, 1, jnp.where(codes == 2, -1, 0))
             total_signs = jnp.sum(signs, axis=0).reshape(-1)[:gf.shape[0]]
             out = total_signs.astype(jnp.float32) * self.threshold
+        return out.reshape(shape).astype(dtype), new_res.reshape(shape)
+
+    def _allreduce_lattice(self, g: jax.Array, residual: Any,
+                           axis_name: str, axis_size: int
+                           ) -> Tuple[jax.Array, Any]:
+        """Homomorphic 2-bit merge: quantize with the same error
+        feedback, then psum the ±1 sign codes on the int8 lattice and
+        scale once — no packed gather, no per-party unpack
+        (compression/sparseagg.py)."""
+        from geomx_tpu.compression.sparseagg import lattice_allreduce_signs
+
+        shape, dtype = g.shape, g.dtype
+        gf = g.reshape(-1).astype(jnp.float32)
+        r = residual.reshape(-1) + gf
+        codes = jnp.where(r >= self.threshold, 1,
+                          jnp.where(r <= -self.threshold, -1, 0)
+                          ).astype(jnp.int8)
+        new_res = r - codes.astype(jnp.float32) * self.threshold
+        out = lattice_allreduce_signs(codes, self.threshold, axis_name,
+                                      axis_size)
         return out.reshape(shape).astype(dtype), new_res.reshape(shape)
 
     def _allreduce_pallas(self, g: jax.Array, residual: Any, axis_name: str,
@@ -133,6 +169,8 @@ class TwoBitCompressor(Compressor):
 
     def wire_bytes_leaf(self, leaf: jax.Array) -> int:
         n = leaf.size
+        if self.sparse_agg:
+            return n  # int8 sign codes on the lattice psum
         if self.use_pallas:
             # the Pallas wire format is row-blocked: 128 int32 words per
             # 2048-element row (geomx_tpu/ops/twobit_pallas.py), so small

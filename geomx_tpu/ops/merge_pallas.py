@@ -1,0 +1,181 @@
+"""Sorted-index segment-sum merge kernel — the compressed-domain merge.
+
+The homomorphic aggregation path (compression/sparseagg.py,
+docs/performance.md "Compressed-domain aggregation") needs one core
+primitive: merge N parties' (value, index) pair streams **by index**
+without materializing anything dense — the segment sum over the
+index-sorted pair sequence.  This module owns that primitive in two
+bit-identical forms:
+
+``merge_sorted_pairs``
+    jnp reference: a fixed binary combining tree over the sorted
+    sequence.  Because float addition is not associative, the merge is
+    DEFINED as this tree — ``rounds = ceil(log2(max_duplicates))``
+    passes in which the element at in-segment rank ``s`` with
+    ``s % 2^(r+1) == 0`` absorbs its neighbour at rank ``s + 2^r``
+    (duplicates of one index are contiguous after the sort, so the
+    neighbour test is one shifted index compare).  Every path — jnp,
+    Pallas, and any future backend — must realize exactly this tree,
+    which is what makes the merged bits independent of which engine ran
+    them.
+
+``merge_sorted_pairs`` with ``fused=True``
+    The Pallas form: one kernel invocation holding the whole pair
+    column in VMEM as an ``[L, 1]`` fp32/int32 column (the PR 4 staging
+    layout), applying the same ``rounds`` shifted combines against a
+    VMEM accumulator and extracting the per-segment totals at head
+    positions.  Interpret mode is the CPU parity oracle.
+
+Output format: same length as the input, the total of each index
+segment at its FIRST (head) position, sentinel ``(0.0, -1)`` everywhere
+else — a valid sparse stream the re-selection stage consumes directly.
+Sentinel input pairs (index ``INT32_MAX`` after the sort's key mapping)
+never combine and come out as sentinels.
+
+VMEM budget: the accumulator plus the three input columns is
+``~16 bytes x L``; the caller bounds ``L`` (party-count x slot budget,
+compression/sparseagg.py) far below the scoped-vmem limit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# post-sort sentinel key: real indices are < 2**31 - 1 (int32 buckets)
+SENTINEL_KEY = 2**31 - 1
+
+_SUBLANE = 8  # fp32 sublane tile: column lengths pad to a multiple
+
+
+def merge_rounds(max_duplicates: int) -> int:
+    """Combining-tree depth for segments of at most ``max_duplicates``
+    entries (one contribution per party => the dc axis size)."""
+    r = 0
+    while (1 << r) < max(1, int(max_duplicates)):
+        r += 1
+    return r
+
+
+def sort_pairs(vals: jax.Array, idx: jax.Array):
+    """Canonicalize a pair stream for the merge: map ``-1`` sentinels to
+    ``SENTINEL_KEY`` (so they sort last) and stable-sort by index.  The
+    stable order makes the combining tree's operand order — and hence
+    the merged BITS — a function of the pair multiset alone, not of the
+    arrival/buffer order the caller happened to hold them in, provided
+    the caller presents pairs in a canonical pre-order (party rank)."""
+    key = jnp.where(idx >= 0, idx, SENTINEL_KEY).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    return vals[order], key[order]
+
+
+def segment_ranks(skey: jax.Array):
+    """(rank-within-segment, head mask) for a sorted key column —
+    integer arithmetic only (cummax of int32), so it is exact and
+    shared verbatim by both merge paths."""
+    m = skey.shape[0]
+    pos = jnp.arange(m, dtype=jnp.int32)
+    prev = jnp.concatenate([jnp.full((1,), -2, jnp.int32), skey[:-1]])
+    head = skey != prev
+    seg_start = jax.lax.cummax(jnp.where(head, pos, 0))
+    return pos - seg_start, head
+
+
+def _merge_tree_ref(svals, skey, rank, rounds: int):
+    """The defining combining tree (jnp reference path)."""
+    v = svals
+    for r in range(rounds):
+        d = 1 << r
+        pv = jnp.concatenate([v[d:], jnp.zeros((d,), v.dtype)])
+        pk = jnp.concatenate(
+            [skey[d:], jnp.full((d,), SENTINEL_KEY, jnp.int32)])
+        take = (pk == skey) & (skey != SENTINEL_KEY) & (rank % (2 * d) == 0)
+        v = jnp.where(take, v + pv, v)
+    head = (rank == 0) & (skey != SENTINEL_KEY)
+    return (jnp.where(head, v, 0.0),
+            jnp.where(head, skey, -1).astype(jnp.int32))
+
+
+def _merge_kernel(L: int, rounds: int, vals_ref, idx_ref, rank_ref,
+                  outv_ref, outi_ref, acc):
+    """Single-invocation kernel: the same combining tree as
+    :func:`_merge_tree_ref`, with the shifted neighbour reads realized
+    as statically-offset column slices of the VMEM refs (the inputs are
+    padded by one tree stride past ``L``, so every slice is in
+    bounds)."""
+    acc[:] = vals_ref[:]
+    for r in range(rounds):
+        d = 1 << r
+        a = acc[0:L, :]
+        b = acc[d:d + L, :]
+        ka = idx_ref[0:L, :]
+        kb = idx_ref[d:d + L, :]
+        g = rank_ref[0:L, :]
+        take = (ka == kb) & (ka != SENTINEL_KEY) & (g % (2 * d) == 0)
+        acc[0:L, :] = jnp.where(take, a + b, a)
+    ka = idx_ref[0:L, :]
+    head = (rank_ref[0:L, :] == 0) & (ka != SENTINEL_KEY)
+    outv_ref[:] = jnp.where(head, acc[0:L, :], 0.0)
+    outi_ref[:] = jnp.where(head, ka, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("rounds", "interpret"))
+def _merge_tree_pallas(svals, skey, rank, rounds: int,
+                       interpret: bool = False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = svals.shape[0]
+    L = -(-m // _SUBLANE) * _SUBLANE
+    stride = 1 << max(rounds - 1, 0)          # largest shifted read
+    Lp = L + -(-stride // _SUBLANE) * _SUBLANE
+
+    def col(x, fill, dtype):
+        x = x.astype(dtype)
+        pad = Lp - m
+        if pad:
+            x = jnp.concatenate([x, jnp.full((pad,), fill, dtype)])
+        return x.reshape(Lp, 1)
+
+    outv, outi = pl.pallas_call(
+        functools.partial(_merge_kernel, L, rounds),
+        in_specs=[
+            pl.BlockSpec((Lp, 1), lambda: (0, 0)),
+            pl.BlockSpec((Lp, 1), lambda: (0, 0)),
+            pl.BlockSpec((Lp, 1), lambda: (0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((L, 1), lambda: (0, 0)),
+                   pl.BlockSpec((L, 1), lambda: (0, 0))),
+        out_shape=(jax.ShapeDtypeStruct((L, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((L, 1), jnp.int32)),
+        scratch_shapes=[pltpu.VMEM((Lp, 1), jnp.float32)],
+        interpret=interpret,
+    )(col(svals, 0.0, jnp.float32), col(skey, SENTINEL_KEY, jnp.int32),
+      col(rank, 0, jnp.int32))
+    return outv.reshape(-1)[:m], outi.reshape(-1)[:m]
+
+
+def merge_sorted_pairs(vals: jax.Array, idx: jax.Array, max_duplicates: int,
+                       fused: bool = False, interpret: bool = False):
+    """Merge a (value, index) pair stream by index.
+
+    ``vals``/``idx`` need NOT be pre-sorted — the canonical stable sort
+    by index runs here (XLA, shared by both paths), then the combining
+    tree realizes the segment sums.  ``max_duplicates`` bounds how many
+    pairs can share one index (the dc axis size: each party contributes
+    an index at most once).  Returns ``(merged_vals, merged_idx)`` of
+    the SAME length: segment totals at head positions, ``(0.0, -1)``
+    sentinels elsewhere.  ``fused=True`` runs the Pallas kernel
+    (``interpret=True`` for CPU parity) — bit-identical to the jnp path
+    by construction (same sort, same tree).
+    """
+    svals, skey = sort_pairs(vals.astype(jnp.float32),
+                             idx.astype(jnp.int32))
+    rank, _head = segment_ranks(skey)
+    rounds = merge_rounds(max_duplicates)
+    if fused:
+        return _merge_tree_pallas(svals, skey, rank, rounds,
+                                  interpret=interpret)
+    return _merge_tree_ref(svals, skey, rank, rounds)

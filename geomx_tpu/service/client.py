@@ -1044,7 +1044,25 @@ class GeoPSClient:
     def pull(self, key: str, priority: int = 0,
              timeout: Optional[float] = 60.0,
              meta: Optional[dict] = None) -> np.ndarray:
-        reply = self.wait(self.pull_async(key, priority, meta=meta), timeout)
+        """Synchronous pull.  Advertises ``sparse_ok``: a server holding
+        a sparse-merged round (compressed-domain aggregation,
+        docs/performance.md) replies with the (value, index) pair set
+        instead of the dense tensor, and THIS is the single decompress
+        of the whole round trip.  Raw `pull_async` + `wait` callers
+        keep the dense wire (they never advertise)."""
+        m = dict(meta or {})
+        m.setdefault("sparse_ok", 1)
+        reply = self.wait(self.pull_async(key, priority, meta=m), timeout)
+        return self._decode_pull_reply(reply)
+
+    @staticmethod
+    def _decode_pull_reply(reply) -> np.ndarray:
+        if reply.meta.get("comp") == "bsc":
+            from geomx_tpu.compression.sparseagg import (
+                decode_pairs_payload, densify_pairs_host)
+            vals, idx = decode_pairs_payload(reply.array)
+            out = densify_pairs_host(vals, idx, int(reply.meta["n"]))
+            return out.reshape(reply.meta["shape"])
         return np.asarray(reply.array, np.float32)
 
     def pull_async(self, key: str, priority: int = 0,

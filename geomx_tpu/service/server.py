@@ -39,9 +39,39 @@ from geomx_tpu.service.protocol import (Msg, MsgType, _log_msg,
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
 
 
+class _SparsePairs:
+    """A compressed (value, index) contribution held WITHOUT densifying
+    (docs/performance.md "Compressed-domain aggregation"): the global
+    tier's sparse merge keeps per-sender pushes in this form and merges
+    them by sorted-index at the round gate — O(k log k) host work per
+    round instead of an O(n) densify per push."""
+
+    __slots__ = ("vals", "idx", "n", "shape")
+
+    def __init__(self, vals: np.ndarray, idx: np.ndarray, n: int, shape):
+        self.vals = np.asarray(vals, np.float32).reshape(-1)
+        self.idx = np.asarray(idx).reshape(-1).astype(np.int64)
+        self.n = int(n)
+        self.shape = tuple(shape)
+
+    def densify(self) -> np.ndarray:
+        from geomx_tpu.compression.sparseagg import densify_pairs_host
+        return densify_pairs_host(self.vals, self.idx,
+                                  self.n).reshape(self.shape)
+
+
+def _contrib_dense(c) -> np.ndarray:
+    return c.densify() if isinstance(c, _SparsePairs) else c
+
+
 class _KeyState:
     def __init__(self, value: np.ndarray):
-        self.value = value.copy()
+        self._value = value.copy()
+        # a sparse-merged round's OVERWRITE-pending (vals, idx) pair
+        # set: the dense form materializes lazily on first dense read
+        # (`value` property), so rounds whose only consumers pull
+        # sparse never pay the O(n) densify
+        self._sparse: "Optional[tuple]" = None
         # this round's per-sender contributions.  Kept SEPARATE (not a
         # running sum) so the round merge sums in sorted-sender order:
         # float addition is commutative but not associative, and at
@@ -68,6 +98,48 @@ class _KeyState:
         # (densified at most once, at the round gate)
         self.rs_rows: list = []
         self.rs_vals: list = []
+
+    @property
+    def value(self) -> np.ndarray:
+        if self._sparse is not None:
+            from geomx_tpu.compression.sparseagg import densify_pairs_host
+            mvals, midx = self._sparse
+            dense = densify_pairs_host(mvals, midx, self._value.size)
+            self._value = dense.reshape(self._value.shape).astype(
+                self._value.dtype, copy=False)
+            self._sparse = None
+        return self._value
+
+    @value.setter
+    def value(self, v: np.ndarray) -> None:
+        self._value = v
+        self._sparse = None
+
+    @property
+    def sparse_value(self) -> "Optional[tuple]":
+        """(vals, idx) when the latest round is sparse-pending, else
+        None.  Indices are unique and sorted; absent coordinates are
+        zero (overwrite-store semantics)."""
+        return self._sparse
+
+    def set_sparse_value(self, mvals: np.ndarray, midx: np.ndarray) -> None:
+        """Install a sparse-merged round as the store value without
+        densifying (overwrite-mode stores only; `value` reads fold it
+        lazily)."""
+        self._sparse = (np.asarray(mvals, np.float32),
+                        np.asarray(midx, np.int64))
+
+    @property
+    def dense_shape(self) -> tuple:
+        return tuple(self._value.shape)
+
+    @property
+    def dense_size(self) -> int:
+        return int(self._value.size)
+
+    @property
+    def dense_dtype(self) -> str:
+        return self._value.dtype.str
 
 
 class GeoPSServer:
@@ -264,6 +336,10 @@ class GeoPSServer:
             "geomx_server_num_workers",
             "Current sync-gate width", ("rank",)).labels(_r)
         self._m_workers.set(num_workers)
+        self._m_sparse_merges = _reg.counter(
+            "geomx_server_sparse_merges_total",
+            "Rounds merged in the compressed (value, index) domain",
+            ("rank",)).labels(_r)
 
         # ---- key-range sharding (docs/resilience.md "Many-party
         # global tier"): owned hash range + the map version redirects
@@ -581,9 +657,31 @@ class GeoPSServer:
         comp = None
         if self._compressor is not None:
             comp = self._comp_state.get(key)
-        return {"value": st.value, "round": st.round,
+        sp = st.sparse_value
+        if sp is not None:
+            # journal the sparse-pending round AS PAIRS: the write-ahead
+            # record stays O(k), matching the merge's cost — replay
+            # densifies once (restore is rare, rounds are not)
+            value = {"__sparse__": True, "vals": sp[0], "idx": sp[1],
+                     "shape": list(st.dense_shape),
+                     "dtype": st.dense_dtype}
+        else:
+            value = st.value
+        return {"value": value, "round": st.round,
                 "pushed": dict(st.pushed), "milestone": st.milestone,
                 "opt": self._opt_blob(key), "comp": comp}
+
+    @staticmethod
+    def _decode_value_record(val) -> np.ndarray:
+        """Inverse of the `_key_record` value field: a sparse round
+        record densifies here (restore/migration time only)."""
+        if isinstance(val, dict) and val.get("__sparse__"):
+            from geomx_tpu.compression.sparseagg import densify_pairs_host
+            n = int(np.prod(val["shape"])) or 1
+            dense = densify_pairs_host(val["vals"], val["idx"], n)
+            return dense.reshape(val["shape"]).astype(
+                np.dtype(val.get("dtype", "<f4")), copy=False)
+        return np.asarray(val)
 
     def _journal(self, rec: dict) -> None:
         """Append one journal record; caller holds self._lock (or runs
@@ -625,10 +723,11 @@ class GeoPSServer:
                 "map_version": self.shard_map_version}
 
     def _apply_durable_key(self, key: str, rec: dict) -> None:
+        value = self._decode_value_record(rec["value"])
         st = self._store.get(key)
         if st is None:
-            st = self._store[key] = _KeyState(np.asarray(rec["value"]))
-        st.value = np.asarray(rec["value"]).copy()
+            st = self._store[key] = _KeyState(value)
+        st.value = value.copy()
         st.round = int(rec.get("round", 0))
         st.pushed = {int(s): int(n)
                      for s, n in dict(rec.get("pushed", {})).items()}
@@ -720,6 +819,27 @@ class GeoPSServer:
         return np.frombuffer(e["b"], dtype=np.dtype(e["d"])).reshape(
             e["s"]).copy()
 
+    @classmethod
+    def _enc_contrib(cls, g) -> Optional[dict]:
+        """Wire-primitive form of one in-flight contribution: dense
+        arrays as `_enc_arr`, sparse (value, index) pair sets as ONE
+        flat dict (marked ``sp``; the wire-meta depth cap forbids
+        nesting `_enc_arr` dicts) so a shard migration moves the open
+        round WITHOUT densifying it."""
+        if isinstance(g, _SparsePairs):
+            return {"sp": 1, "vb": g.vals.tobytes(),
+                    "ib": np.ascontiguousarray(g.idx).tobytes(),
+                    "n": g.n, "shape": list(g.shape)}
+        return cls._enc_arr(g)
+
+    @classmethod
+    def _dec_contrib(cls, e):
+        if isinstance(e, dict) and e.get("sp"):
+            return _SparsePairs(
+                np.frombuffer(e["vb"], np.float32),
+                np.frombuffer(e["ib"], np.int64), e["n"], e["shape"])
+        return cls._dec_arr(e)
+
     def _wrong_shard_reply_locked(self, key: str) -> Optional[Msg]:
         """The locked re-check of the (unlocked, fast-path) range gate
         in ``_handle``: a push that passed the fast path can reach the
@@ -773,12 +893,21 @@ class GeoPSServer:
         record.  Read-only (migration copies first, drops only after
         the import is acknowledged).  Caller holds self._lock."""
         st = self._store[key]
-        rec = {"value": self._enc_arr(st.value), "round": int(st.round),
+        sp = st.sparse_value
+        if sp is not None:
+            # a sparse-pending round migrates IN PAIR FORM (the one
+            # _enc_contrib encoding): O(k) bytes over the migration
+            # wire instead of the O(n) densify the feature removes
+            value = self._enc_contrib(_SparsePairs(
+                sp[0], sp[1], st.dense_size, st.dense_shape))
+        else:
+            value = self._enc_arr(st.value)
+        rec = {"value": value, "round": int(st.round),
                "pushed": {int(s): int(n) for s, n in st.pushed.items()},
                "milestone": self._enc_arr(st.milestone),
                "opt": self._opt_blob(key), "comp": None,
                "count": int(st.count),
-               "contribs": {int(s): self._enc_arr(g)
+               "contribs": {int(s): self._enc_contrib(g)
                             for s, g in st.contribs.items()},
                "relay_error": st.relay_error}
         comp = self._comp_state.get(key) \
@@ -834,16 +963,27 @@ class GeoPSServer:
         flight.  Idempotent round-wise: migrated ``pushed`` counts make
         a re-routed client's replayed push an idempotent ACK.  Caller
         holds self._lock."""
-        value = self._dec_arr(rec["value"])
+        enc = rec["value"]
+        sparse_pending = None
+        if isinstance(enc, dict) and enc.get("sp"):
+            # sparse-pending migration record: install the pair set
+            # lazily, exactly as the exporter held it
+            sp = self._dec_contrib(enc)
+            sparse_pending = (sp.vals, sp.idx)
+            value = np.zeros(enc["shape"], np.float32)
+        else:
+            value = self._dec_arr(enc)
         st = self._store.get(key)
         if st is None:
             st = self._store[key] = _KeyState(value)
         st.value = value
+        if sparse_pending is not None:
+            st.set_sparse_value(*sparse_pending)
         st.round = int(rec.get("round", 0))
         st.pushed = {int(s): int(n)
                      for s, n in dict(rec.get("pushed", {})).items()}
         st.milestone = self._dec_arr(rec.get("milestone"))
-        st.contribs = {int(s): self._dec_arr(g)
+        st.contribs = {int(s): self._dec_contrib(g)
                        for s, g in dict(rec.get("contribs", {})).items()}
         st.count = int(rec.get("count", 0))
         st.relay_error = rec.get("relay_error")
@@ -1506,10 +1646,16 @@ class GeoPSServer:
                 pulled = c0.pull(key, timeout=120.0,
                                  meta={"min_round": rnd, "reliable": True})
             return np.asarray(pulled, np.float32).reshape(grad.shape)
+        from geomx_tpu.compression.sparseagg import (PAIR_WIRE_MAX_N,
+                                                     encode_pairs_payload)
         meta = {}
         payload = grad
         if self._compressor is not None and \
-                self._compressor.name in ("bsc", "mpq"):
+                self._compressor.name in ("bsc", "mpq") and \
+                int(grad.size) < PAIR_WIRE_MAX_N:
+            # the pair format's f32 index half is exact only below
+            # PAIR_WIRE_MAX_N; bigger tensors relay dense so no
+            # producer ever emits a silently-rounded index
             import jax.numpy as jnp
             comp = self._compressor
             state = self._comp_state[key]
@@ -1520,8 +1666,8 @@ class GeoPSServer:
                     v.reshape(-1))
                 self._comp_state[key] = (np.asarray(u).reshape(grad.shape),
                                          np.asarray(v).reshape(grad.shape))
-                payload = np.concatenate([np.asarray(vals),
-                                          np.asarray(idx, np.float32)])
+                payload = encode_pairs_payload(np.asarray(vals),
+                                               np.asarray(idx))
                 meta = {"comp": "bsc", "n": int(grad.size),
                         "shape": list(grad.shape)}
         elif self._compressor is not None and self._compressor.name == "fp16":
@@ -1623,15 +1769,32 @@ class GeoPSServer:
 
     def _decompress_incoming(self, msg: Msg) -> np.ndarray:
         if msg.meta.get("comp") == "bsc":
-            n = msg.meta["n"]
-            pairs = np.asarray(msg.array, np.float32)
-            k = pairs.size // 2
-            vals, idx = pairs[:k], pairs[k:].astype(np.int64)
-            out = np.zeros((n,), np.float32)
-            valid = idx >= 0
-            np.add.at(out, idx[valid], vals[valid])
+            from geomx_tpu.compression.sparseagg import (
+                decode_pairs_payload, densify_pairs_host)
+            vals, idx = decode_pairs_payload(msg.array)
+            out = densify_pairs_host(vals, idx, msg.meta["n"])
             return out.reshape(msg.meta["shape"])
         return np.asarray(msg.array, np.float32)
+
+    def _incoming_payload(self, msg: Msg):
+        """A push's merge payload: compressed (value, index) pushes STAY
+        compressed (:class:`_SparsePairs`) when this store can merge
+        them in the compressed domain — sync mode, whole-tensor push,
+        no HFA (HFA pushes are parameters, and the milestone algebra
+        needs dense), and the tensor inside the pair wire format's
+        float32-exact index range (``PAIR_WIRE_MAX_N``, the same bound
+        the sparse-reply side and the relay encode enforce) — otherwise
+        the legacy per-push densify."""
+        from geomx_tpu.compression.sparseagg import (PAIR_WIRE_MAX_N,
+                                                     decode_pairs_payload)
+        if msg.meta.get("comp") == "bsc" and self.mode == "sync" \
+                and self.hfa_k2 is None \
+                and msg.meta.get("chunk") is None \
+                and int(msg.meta.get("n", 0)) < PAIR_WIRE_MAX_N:
+            vals, idx = decode_pairs_payload(msg.array)
+            return _SparsePairs(vals, idx, msg.meta["n"],
+                                msg.meta["shape"])
+        return self._decompress_incoming(msg)
 
     def _handle_push(self, conn, msg: Msg):
         self._m_pushes.inc()
@@ -1656,14 +1819,15 @@ class GeoPSServer:
                     self._reply(conn, msg, Msg(MsgType.ERROR, meta={
                         "error": f"no key {key}"}))
                     return
-                tail = st.value.shape[1:]
+                tail = st.dense_shape[1:]  # shape only: never force the
+                # lazy densify of a sparse-pending round for a header read
             rows = np.asarray(msg.meta["rows"], np.int64)
             rs = (rows,
                   np.asarray(msg.array, np.float32).reshape(
                       (len(rows),) + tail))
             grad = None
         else:
-            grad = self._decompress_incoming(msg)
+            grad = self._incoming_payload(msg)
         # resend dedup: a push is not idempotent (it merges), so replayed
         # (sender, rid) signatures are re-ACKed without re-merging — the
         # reference Resender's signature set (src/resender.h).  Only
@@ -1953,7 +2117,8 @@ class GeoPSServer:
             st.rs_vals.append(rs[1])
         else:
             prev = st.contribs.get(msg.sender)
-            st.contribs[msg.sender] = grad if prev is None else prev + grad
+            st.contribs[msg.sender] = grad if prev is None else \
+                self._combine_contribs(prev, grad)
         # a TS relay-merged push carries the contributions of num_merge
         # workers (reference KVMeta.num_merge counting toward the sync
         # gate, kvstore_dist_server.h:1324)
@@ -1962,6 +2127,18 @@ class GeoPSServer:
         self._reply(conn, msg, Msg(MsgType.ACK, key=key))
         if st.count >= self.num_workers:
             self._complete_merge_locked(key, st)
+
+    @staticmethod
+    def _combine_contribs(prev, new):
+        """Two pushes from ONE sender within a round: merge them.  Two
+        sparse contributions merge by sorted-index (still compressed);
+        any dense participant densifies the pair."""
+        if isinstance(prev, _SparsePairs) and isinstance(new, _SparsePairs):
+            from geomx_tpu.compression.sparseagg import merge_pairs_host
+            mv, mi = merge_pairs_host([(prev.vals, prev.idx),
+                                       (new.vals, new.idx)])
+            return _SparsePairs(mv, mi, new.n, new.shape)
+        return _contrib_dense(prev) + _contrib_dense(new)
 
     def _complete_merge_locked(self, key: str, st: _KeyState):
         """Close a full sync round for ``key``: apply or relay the merge
@@ -1974,13 +2151,26 @@ class GeoPSServer:
         order: float addition is not associative, so an arrival-ordered
         running sum would tie the merged bits to thread scheduling —
         sorted-order summation is what makes a 16+-party chaos replay
-        bit-exact against its uninterrupted baseline."""
+        bit-exact against its uninterrupted baseline.  Sparse (value,
+        index) contributions merge in the same sorted-sender order by
+        sorted-index segment fold (compression/sparseagg.py
+        merge_pairs_host) and the result STAYS sparse: O(k log k) host
+        work, no densify until a dense consumer actually reads."""
         merged = None
         if st.contribs:
             parts = [st.contribs[s] for s in sorted(st.contribs)]
-            merged = parts[0]
-            for g in parts[1:]:
-                merged = merged + g
+            if all(isinstance(p, _SparsePairs) for p in parts):
+                from geomx_tpu.compression.sparseagg import merge_pairs_host
+                mv, mi = merge_pairs_host(
+                    [(p.vals, p.idx) for p in parts])
+                merged = _SparsePairs(mv, mi, parts[-1].n,
+                                      parts[-1].shape)
+                self._m_sparse_merges.inc()
+            else:
+                dense = [_contrib_dense(p) for p in parts]
+                merged = dense[0]
+                for g in dense[1:]:
+                    merged = merged + g
         st.contribs, st.count = {}, 0
         rnd = st.round + 1  # the round this merge completes
         self.profiler.instant(f"ServerMerge:{key}", "kvstore",
@@ -2002,7 +2192,7 @@ class GeoPSServer:
                 # pulls see fresh aggregates — the reference calls
                 # ApplyUpdates every round and skips only the WAN hop
                 # (kvstore_dist_server.h:1326-1332)
-                self._apply(key, merged)
+                self._apply(key, _contrib_dense(merged))
                 if (st.round + 1) % self.hfa_k2 == 0:
                     # milestone sync: relay the normalized delta
                     # (kvstore_dist_server.h:1334-1338).  The global
@@ -2021,11 +2211,37 @@ class GeoPSServer:
                                               rnd))
                     return
             else:
-                self._relay_enqueue(key, (merged, False, False, None, rnd))
+                # the WAN relay transports dense party aggregates (its
+                # own compressor re-sparsifies on the hop if configured)
+                self._relay_enqueue(
+                    key, (_contrib_dense(merged), False, False, None, rnd))
                 return
         else:
-            self._apply(key, merged)
+            self._apply_merged(key, merged)
         self._finish_round_locked(key, st)
+
+    def _apply_merged(self, key: str, merged) -> None:
+        """Merged round -> store, staying in the compressed domain when
+        the store semantics allow: an overwrite store installs the pair
+        set lazily (pulls of the round can reply sparse), an accumulate
+        store adds the k pairs in place (O(k)); optimizer stores need
+        the dense gradient and densify the MERGED set once per round —
+        still never once per push."""
+        if isinstance(merged, _SparsePairs) and self._tx is None \
+                and self._native_sgd is None:
+            st = self._store[key]
+            valid = merged.idx >= 0
+            if self.accumulate:
+                base = st.value  # folds any pending sparse round first
+                flat = base.reshape(-1)
+                np.add.at(flat, merged.idx[valid],
+                          merged.vals[valid].astype(flat.dtype,
+                                                    copy=False))
+                st.value = base
+            else:
+                st.set_sparse_value(merged.vals[valid], merged.idx[valid])
+            return
+        self._apply(key, _contrib_dense(merged))
 
     def evict_worker(self, sender: int) -> int:
         """Server-side worker eviction (resilience/): shrink the sync
@@ -2085,8 +2301,11 @@ class GeoPSServer:
         for c, req, need in st.waiting_pulls:
             if st.round >= need:
                 rows = req.meta.get("rows")
-                val = st.value if rows is None else \
-                    st.value[np.asarray(rows, np.int64)]
+                sparse = self._sparse_reply_locked(st, req) \
+                    if rows is None else None
+                val = None if sparse is not None else (
+                    st.value if rows is None else
+                    st.value[np.asarray(rows, np.int64)])
                 self.profiler.instant(
                     f"ServerPull:{key}", "kvstore",
                     args={"key": key, "round_id": st.round,
@@ -2094,7 +2313,8 @@ class GeoPSServer:
                 try:
                     self._reply_pull_value(
                         c, req, key, val,
-                        pushed=st.pushed.get(req.sender, 0))
+                        pushed=st.pushed.get(req.sender, 0),
+                        sparse=sparse)
                 except OSError:
                     pass  # dead waiter (crashed worker): drop its entry —
                     # the round must still complete for the live ones
@@ -2313,17 +2533,38 @@ class GeoPSServer:
                     st.waiting_pulls.append((conn, msg, need))
                 return
             rows = msg.meta.get("rows")
-            val = st.value if rows is None else \
-                st.value[np.asarray(rows, np.int64)]
+            sparse = self._sparse_reply_locked(st, msg) \
+                if rows is None else None
+            val = None if sparse is not None else (
+                st.value if rows is None else
+                st.value[np.asarray(rows, np.int64)])
             self.profiler.instant(
                 f"ServerPull:{msg.key}", "kvstore",
                 args={"key": msg.key, "round_id": st.round,
                       "sender": msg.sender})
             self._reply_pull_value(conn, msg, msg.key, val,
-                                   pushed=st.pushed.get(msg.sender, 0))
+                                   pushed=st.pushed.get(msg.sender, 0),
+                                   sparse=sparse)
+
+    @staticmethod
+    def _sparse_reply_locked(st: _KeyState, req: Msg):
+        """(vals, idx, n, shape) when this pull can be answered from a
+        sparse-pending round WITHOUT densifying: the requester opted in
+        (``sparse_ok`` — its client decompresses once), the round is
+        sparse-pending, and every index fits the pair wire format's
+        float32-exact range.  Otherwise None (dense reply)."""
+        from geomx_tpu.compression.sparseagg import PAIR_WIRE_MAX_N
+        sp = st.sparse_value
+        if sp is None or not req.meta.get("sparse_ok"):
+            return None
+        n = st.dense_size
+        if n >= PAIR_WIRE_MAX_N:  # idx rides the f32 half of the pairs
+            return None
+        return sp[0], sp[1], n, st.dense_shape
 
     def _reply_pull_value(self, conn, req: Msg, key: str, val,
-                          pushed: Optional[int] = None):
+                          pushed: Optional[int] = None,
+                          sparse: Optional[tuple] = None):
         """Answer a PULL: whole tensor directly, or — when the request
         opted into P3 pull chunking and the tensor is big — as
         priority-tagged chunks through the connection's priority send
@@ -2334,7 +2575,24 @@ class GeoPSServer:
         (journaled write-ahead of this reply): the proof the client's
         session-resume layer needs to release its retained re-push
         frames for rounds <= it — a reply alone proves nothing about a
-        push pipelined AFTER the pull was issued."""
+        push pipelined AFTER the pull was issued.
+
+        ``sparse`` (vals, idx, n, shape): answer from a sparse-merged
+        round in the compressed pair format (the relay wire format —
+        values then f32-cast indices); the requester's client
+        decompresses ONCE.  Sparse replies are pair-sized and bypass
+        P3 chunking."""
+        if sparse is not None:
+            from geomx_tpu.compression.sparseagg import encode_pairs_payload
+            mvals, midx, n, shape = sparse
+            reply = Msg(MsgType.PULL_REPLY, key=key,
+                        meta={"comp": "bsc", "n": int(n),
+                              "shape": list(shape)},
+                        array=encode_pairs_payload(mvals, midx))
+            if pushed is not None:
+                reply.meta["pushed"] = int(pushed)
+            self._reply(conn, req, reply)
+            return
         ce = req.meta.get("p3_chunk_elems")
         if not ce or val.size <= int(ce):
             reply = Msg(MsgType.PULL_REPLY, key=key, array=val)

@@ -188,13 +188,14 @@ class ShardedGlobalClient:
         c = self._rebuild_client(idx)
         prog = c.recover()
         for key, held in list(self._retained.items()):
-            rnd, grad, prio = held
+            rnd, grad, prio, meta = held
             if self._map.shard_for(key) == idx and \
                     prog.get(key, 0) < rnd:
                 # the round died with the old incarnation: re-push it
                 # (idempotent under the per-sender round dedup if a
                 # durable copy survived after all)
-                c.push(key, grad, priority=prio, meta={"round": rnd})
+                c.push(key, grad, priority=prio,
+                       meta={**meta, "round": rnd})
 
     def _routed(self, key: str, op):
         """Run ``op(client)`` against the key's current range owner,
@@ -234,12 +235,12 @@ class ShardedGlobalClient:
         self._routed(key, lambda c: c.init(key, value, meta=meta))
 
     def _retain(self, key: str, rnd: int, g: np.ndarray,
-                priority: int) -> None:
+                priority: int, meta: Optional[dict] = None) -> None:
         with self._lock:
             prev = self._retained.get(key)
             if prev is not None:
                 self._m_resend_buf.dec(prev[1].nbytes)
-            self._retained[key] = (rnd, g, priority)
+            self._retained[key] = (rnd, g, priority, dict(meta or {}))
             self._m_resend_buf.inc(g.nbytes)
 
     def _release(self, key: str) -> None:
@@ -248,7 +249,12 @@ class ShardedGlobalClient:
             if held is not None:
                 self._m_resend_buf.dec(held[1].nbytes)
 
-    def push(self, key: str, grad: np.ndarray, priority: int = 0) -> None:
+    def push(self, key: str, grad: np.ndarray, priority: int = 0,
+             meta: Optional[dict] = None) -> None:
+        """``meta`` passes through to the shard push (e.g. the
+        compressed-pair wire header ``{"comp": "bsc", "n": ..,
+        "shape": ..}`` of the sparse server merge) — retained alongside
+        the payload so a failover re-push replays the same form."""
         g = np.asarray(grad)
         if g.dtype != np.float16:
             g = g.astype(np.float32, copy=False)
@@ -259,9 +265,11 @@ class ShardedGlobalClient:
             # caller's buffer, and a reused gradient buffer must not
             # mutate the failover re-push (the client layer retains
             # immutable encoded frames for the same reason)
-            self._retain(key, rnd, np.array(g, copy=True), priority)
+            self._retain(key, rnd, np.array(g, copy=True), priority, meta)
+        m = dict(meta or {})
+        m["round"] = rnd
         self._routed(key, lambda c: c.push(
-            key, g, priority=priority, meta={"round": rnd}))
+            key, g, priority=priority, meta=m))
 
     def pull(self, key: str, priority: int = 0,
              timeout: Optional[float] = 120.0) -> np.ndarray:

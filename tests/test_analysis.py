@@ -258,6 +258,8 @@ def test_corpus_program_flagged_with_right_rule(entry):
 GREEN_CONFIGS = (
     ("vanilla", {"compression": "none"}),
     ("bsc", {"compression": "bsc,0.05,min_sparse_size=16"}),
+    ("bsc_sparseagg",
+     {"compression": "bsc,0.05,min_sparse_size=16,sparse_agg=1"}),
     ("mpq", {"compression": "mpq,0.05"}),
     ("pipelined", {"compression": "none", "pipeline_depth": 1}),
     ("degraded", {"compression": "none", "_membership": (True, False)}),
@@ -414,3 +416,65 @@ def test_trainer_audit_off_is_inert(monkeypatch):
     assert tr._audit is False
     state, _m = tr.train_step(state, xb, yb)
     assert tr._audit_args is None and tr._audit_sigs == {}
+
+
+# --------------------------------------------------------------------------
+# GX-PURITY-001 post-collective side (merge-without-densify)
+# --------------------------------------------------------------------------
+
+
+def test_purity_post_collective_counts_only_after_last_collective():
+    """The merge rule anchors at the FINAL collective: a two-bucket
+    program whose bucket-1 select chain (incl. its dense EF-reset
+    scatter) runs after bucket-0's gather must stay clean — only what
+    follows the last collective counts, and the single final decompress
+    is the allowed densify."""
+    from geomx_tpu.compression import BucketedCompressor
+    from geomx_tpu.compression.bisparse import BiSparseCompressor
+
+    comp = BucketedCompressor(
+        BiSparseCompressor(ratio=0.05, select="exact", min_sparse_size=1,
+                           fused=False, sparse_agg=False),
+        bucket_bytes=16 * 1024)
+    params = [jnp.zeros((4000,), jnp.float32),
+              jnp.zeros((3800,), jnp.float32)]
+    assert len(comp.init_state(params)) == 2  # really two buckets
+    findings = audit_compressed_path(comp, params)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_purity_flags_second_densify_after_final_collective():
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P_
+
+    from geomx_tpu.analysis.passes import PurityPass
+    from geomx_tpu.parallel.collectives import shard_map_compat
+
+    n, k = 4096, 64
+
+    def bad(vals, idx):
+        g = lax.all_gather(vals, "dc")            # compressed wire
+        gi = lax.all_gather(idx, "dc")
+        out = jnp.zeros((n,), jnp.float32)
+        for p in range(2):                        # per-party densify
+            ok = gi[p] >= 0
+            out = out + jnp.zeros((n,), jnp.float32).at[
+                jnp.where(ok, gi[p], 0)].add(jnp.where(ok, g[p], 0.0))
+        return out
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dc",))
+    fn = shard_map_compat(
+        lambda v, i: bad(v[0], i[0])[None], mesh,
+        in_specs=(P_("dc"), P_("dc")), out_specs=P_("dc"))
+    jx = jax.make_jaxpr(fn)(jnp.zeros((2, k), jnp.float32),
+                            jnp.zeros((2, k), jnp.int32))
+    findings = PurityPass().run(jx, AuditContext(dense_bytes=4 * n))
+    assert findings and all(f.rule_id == "GX-PURITY-001"
+                            for f in findings)
+    assert any("after the final collective" in f.message
+               for f in findings)
+    # raising the allowance to cover both densifies silences the rule
+    clean = PurityPass().run(jx, AuditContext(
+        dense_bytes=4 * n,
+        extras={"allowed_dense_after_collective": 2}))
+    assert clean == []

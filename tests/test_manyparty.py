@@ -346,6 +346,87 @@ def test_merge_is_sorted_sender_order_not_arrival_order():
     assert np.array_equal(outs[0], outs[2])
 
 
+def _pairs_payload(vals, idx):
+    from geomx_tpu.compression.sparseagg import encode_pairs_payload
+    return encode_pairs_payload(np.asarray(vals, np.float32),
+                                np.asarray(idx))
+
+
+def test_sparse_merge_bit_exact_across_orders_and_rebalance(tmp_path):
+    """The sorted-sender bit-equality contract extended to compressed
+    (value, index) rounds (docs/performance.md "Compressed-domain
+    aggregation"): a sparse round merges bit-identically across
+    shuffled push arrival orders, AND across a mid-round shard
+    rebalance — the open round's sparse contributions migrate in pair
+    form (`_enc_contrib`) and complete at the new owner with the same
+    bits."""
+    n = 64
+    meta = {"comp": "bsc", "n": n, "shape": [n]}
+    # catastrophic-cancellation values: any reassociation changes bits
+    payloads = {
+        0: _pairs_payload([np.float32(1e8), 1.0], [3, 10]),
+        1: _pairs_payload([np.float32(-1e8), 2.0], [3, 20]),
+    }
+
+    def run(shuffle, rebalance):
+        sched, servers = _tier(tmp_path / f"t{shuffle}{rebalance}",
+                               shards=2, workers=2)
+        ws = [ShardedGlobalClient(("127.0.0.1", sched.port), sender_id=p,
+                                  reconnect=True) for p in range(2)]
+        sc = SchedulerClient(("127.0.0.1", sched.port))
+        try:
+            m = ShardMap.from_meta(sc.shard_map())
+            hot = [f"h{i}" for i in range(64)
+                   if m.shard_for(f"h{i}") == 0][:3]
+            cold = [f"c{i}" for i in range(64)
+                    if m.shard_for(f"c{i}") == 1][:1]
+            for k in hot + cold:
+                for w in ws:
+                    w.init(k, np.zeros(n, np.float32))
+            # a completed warm-up round builds the rebalance's load
+            # window (sparse pushes count like dense ones)
+            for k in hot:
+                for p in (ws if not shuffle else ws[::-1]):
+                    p.push(k, _pairs_payload([1.0], [5]),
+                           meta=dict(meta))
+                for w in ws:
+                    w.pull(k)
+            # open round 2: only worker 0 pushed its pairs
+            for k in hot:
+                ws[0].push(k, payloads[0], meta=dict(meta))
+            if rebalance:
+                res = sc.rebalance_shards(min_gain=0.05)
+                assert res["changed"] and res["moved_keys"] > 0
+                m2 = ShardMap.from_meta(res["map"])
+                assert any(m2.shard_for(k) != 0 for k in hot)
+            # worker 1 completes round 2 (re-routing via redirect when
+            # the key moved)
+            for k in hot:
+                ws[1].push(k, payloads[1], meta=dict(meta))
+            outs = {k: np.asarray(ws[0].pull(k, timeout=60.0))
+                    for k in hot}
+            prog = ws[0].progress()
+            assert all(prog[k] == 2 for k in hot), prog
+            return outs
+        finally:
+            sc.close()
+            _teardown(sched, servers, ws)
+
+    base = run(shuffle=False, rebalance=False)
+    shuffled = run(shuffle=True, rebalance=False)
+    rebal = run(shuffle=False, rebalance=True)
+    for k, v in base.items():
+        # accumulate store: round 1 (1.0 at idx 5) + the sparse round-2
+        # merge in sorted-sender order
+        exp = np.zeros(n, np.float32)
+        exp[5] = 2.0
+        exp[3] = np.float32(np.float32(1e8) + np.float32(-1e8))
+        exp[10], exp[20] = 1.0, 2.0
+        np.testing.assert_array_equal(v, exp, err_msg=k)
+        np.testing.assert_array_equal(v, shuffled[k], err_msg=k)
+        np.testing.assert_array_equal(v, rebal[k], err_msg=k)
+
+
 # ---- P3-safe session resume + resend buffer -------------------------------
 
 

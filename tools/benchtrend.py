@@ -9,11 +9,14 @@ acceptance: its three boolean gates plus the controller's
 time-to-loss-target, lower is better), ``RECOVERY_r*.json`` (the
 ``--compare-recovery`` host-plane kill/restart acceptance: its
 bit-exactness/restart/corruption boolean gates plus the recovery
-stall, lower is better) and ``MANYPARTY_r*.json`` (the
+stall, lower is better), ``MANYPARTY_r*.json`` (the
 ``--compare-manyparty`` sharded-global-tier acceptance: bit-exactness /
 zero-lost-rounds / stall-bounded / failover / rebalance booleans plus
 the merge-throughput scaling ratio over shard count, higher is
-better).
+better) and ``SPARSEAGG_r*.json`` (the ``--compare-sparseagg``
+compressed-domain aggregation acceptance: purity / bit-exactness /
+lattice booleans plus the bsc-vs-dense samples/sec ratio at the
+modeled multi-party topology, higher is better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
 until a human re-read the numbers.  This tool makes the trajectory a
@@ -64,6 +67,7 @@ DIRECTION = {
     "time_to_target_s": "down",
     "vs_baseline": "up",
     "merge_throughput_scaling": "up",
+    "sparse_vs_dense": "up",
 }
 
 
@@ -133,6 +137,33 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
             out["merge_throughput_scaling"] = float(thr["scaling"])
         # the raw stall is gated through stall_bounded — like the
         # RECOVERY series, the sub-minute absolute would flake a band
+        return out
+    if rec.get("mode") == "compare_sparseagg":  # SPARSEAGG_r*
+        for gate in ("ok", "sparse_beats_dense"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        pur = rec.get("purity")
+        if isinstance(pur, dict):
+            for gate in ("purity_clean", "zero_shard_purity_clean",
+                         "dense_merge_flagged"):
+                if gate in pur:
+                    out[gate] = bool(pur[gate])
+        for section, gate in (("dc_parity", "merged_bit_exact_paths"),
+                              ("server_merge", "merged_bit_exact_orders"),
+                              ("lattice", "fp16_lattice_psum"),
+                              ("lattice", "twobit_lattice_psum"),
+                              ("zero_parity",
+                               "zero_shard_bit_exact_paths")):
+            sec = rec.get(section)
+            if isinstance(sec, dict) and gate in sec:
+                out[gate] = bool(sec[gate])
+        if isinstance(rec.get("sparse_vs_dense"), (int, float)):
+            # machine-sensitive (CPU speed moves the compute term); the
+            # band still catches a collapse back below 1.0
+            out["sparse_vs_dense"] = float(rec["sparse_vs_dense"])
+        dev = rec.get("device") or {}
+        if isinstance(dev, dict) and dev.get("device_kind"):
+            out["device_kind"] = dev["device_kind"]
         return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
@@ -232,7 +263,8 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
         patterns: Optional[List[str]] = None) -> dict:
     patterns = patterns or ["BENCH_CAPTURED_r*.json", "BENCH_r*.json",
                             "MULTICHIP_r*.json", "CONTROL_r*.json",
-                            "RECOVERY_r*.json", "MANYPARTY_r*.json"]
+                            "RECOVERY_r*.json", "MANYPARTY_r*.json",
+                            "SPARSEAGG_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     unreadable: List[str] = []
     for pat in patterns:
