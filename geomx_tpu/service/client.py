@@ -46,6 +46,25 @@ class _RelayConnectError(OSError):
     the partial may safely go elsewhere."""
 
 
+def _ledger_push_hop(msg: "Msg", nbytes: int) -> None:
+    """Fleet round ledger (telemetry/ledger.py): one ``push`` hop per
+    PUSH frame submitted — each P3 chunk is its own hop, so the round's
+    causal chain shows the chunk set the wire really carried.  Best-
+    effort like every ledger write."""
+    rid = msg.meta.get("round")
+    if msg.type is not MsgType.PUSH or rid is None or msg.key is None:
+        return
+    try:
+        from geomx_tpu.telemetry.ledger import PUSH, record_hop
+        detail = None
+        if msg.meta.get("chunk") is not None:
+            detail = {"chunk": int(msg.meta["chunk"])}
+        record_hop(msg.key, int(rid), PUSH, party=msg.sender,
+                   nbytes=nbytes, detail=detail)
+    except Exception:
+        pass
+
+
 class WrongShardError(RuntimeError):
     """A key-range sharded server refused a request for a key outside
     its owned range (docs/resilience.md "Many-party global tier"): the
@@ -369,10 +388,15 @@ class GeoPSClient:
                         ev.set()
                 return
             gen = msg.meta.get("gen")
-            if gen is not None:
+            if gen is not None and msg.meta.get("chunk") is None:
                 # every server/scheduler reply carries its generation
                 # token; recording it is what makes the NEXT reconnect
-                # able to tell "socket churn" from "process restart"
+                # able to tell "socket churn" from "process restart".
+                # Chunked pull replies are excluded: their "gen" is the
+                # reply-slicing generation (ChunkAssembler signature),
+                # and recording it here would poison restart detection
+                # with a small counter that can collide with a durable
+                # generation token.
                 self._server_gen = gen
             if msg.type == MsgType.TS_DIRECTIVE:
                 # scheduler decided where this node's partial goes; the
@@ -406,15 +430,29 @@ class GeoPSClient:
                 elif self.reply_log is not None and \
                         msg.type == MsgType.PULL_REPLY:
                     self.reply_log.append((msg.key, None))
-                if msg.type == MsgType.PULL_REPLY and self._reconnect \
-                        and msg.key is not None:
+                if msg.type == MsgType.PULL_REPLY and \
+                        msg.key is not None:
                     # the reply's "pushed" meta is the requester's
                     # merged-round count at reply time (journaled
                     # write-ahead of the reply): retained re-push
                     # frames for rounds it covers are no longer needed
-                    self._release_push(msg.key,
-                                       proved_round=msg.meta.get(
-                                           "pushed"))
+                    pushed = msg.meta.get("pushed")
+                    if self._reconnect:
+                        self._release_push(msg.key, proved_round=pushed)
+                    if pushed:
+                        # ...and it is the WORKER process's completion
+                        # proof for its ledger records: a client-side
+                        # process never sees the server's merge, so
+                        # rounds it opened would otherwise age open
+                        # until the orphan bound (false stuck_round
+                        # firings in healthy steady state)
+                        try:
+                            from geomx_tpu.telemetry.ledger import \
+                                get_round_ledger
+                            get_round_ledger().complete_through(
+                                msg.key, int(pushed))
+                        except Exception:
+                            pass
                 p.reply = msg
                 p.event.set()
 
@@ -483,10 +521,21 @@ class GeoPSClient:
                 continue
             with self._wlock:
                 self._sock = sock
-            self._replay_pending()
+            self._replay_pending(sock)
             self._conn_ok.set()
             return True
         return False
+
+    def _direct_send(self, sock: socket.socket, frame: bytes) -> None:
+        """Write one pre-encoded frame straight onto a socket (resume
+        path): replayed state-restoring frames must reach the server
+        BEFORE anything queued during the outage, and the shared send
+        queue is FIFO per priority — a pull submitted while the server
+        was down would otherwise overtake the replayed push it depends
+        on and read pre-crash state."""
+        with self._wlock:
+            sock.sendall(len(frame).to_bytes(4, "little") + frame)
+        wire_stats.add_sent(len(frame) + 4)
 
     def _direct_rpc(self, sock: socket.socket, msg: Msg) -> Msg:
         """One synchronous request on a NOT-yet-installed socket (the
@@ -542,9 +591,29 @@ class GeoPSClient:
                     # in-flight round died with the old incarnation —
                     # re-push the retained frame(s) (a P3-chunked round
                     # replays its whole chunk set; the server's
-                    # (sender, rid) / round dedup absorbs survivors)
+                    # (sender, rid) / round dedup absorbs survivors).
+                    # Sent DIRECTLY on the resume socket: a request
+                    # queued during the outage must not overtake the
+                    # replay it depends on (happens-before).
                     for frame in frames:
-                        self._sendq.push(frame, prio)
+                        self._direct_send(sock, frame)
+                    try:
+                        # ledger: the restart is attributed to the exact
+                        # round it interrupted (frames replay verbatim
+                        # pre-encoded, so the encode-side accounting
+                        # already counted them once; the receiver's
+                        # decode counts the re-delivery)
+                        from geomx_tpu.telemetry.ledger import (REPLAY,
+                                                                record_hop)
+                        record_hop(key, rnd, REPLAY,
+                                   party=self.sender_id,
+                                   shard=hello.meta.get("shard_index"),
+                                   nbytes=sum(len(f) + 4 for f in frames),
+                                   detail={"reason": "server_restart",
+                                           "generation": gen,
+                                           "frames": len(frames)})
+                    except Exception:
+                        pass
             for key, srv_rnd in prog.items():
                 if srv_rnd > self._key_rounds.get(key, 0):
                     # server persisted rounds whose ACKs we never saw:
@@ -565,19 +634,28 @@ class GeoPSClient:
             self._server_gen = gen
         sock.settimeout(None)
 
-    def _replay_pending(self) -> None:
-        """Re-queue every un-answered resendable frame on the fresh
+    def _replay_pending(self, sock: socket.socket) -> None:
+        """Replay every un-answered resendable frame on the fresh
         connection (the server dedups replays); non-resendable control
         requests (INIT/COMMAND/BARRIER) fail fast with the
-        ConnectionError they always got."""
+        ConnectionError they always got.  Replays are written DIRECTLY
+        (see :meth:`_direct_send`) so frames submitted pre-crash keep
+        their happens-before edge over frames queued during the
+        outage; a direct send that fails falls back to the queue — the
+        resend timer re-delivers, and a dead socket re-enters
+        reestablish anyway."""
         with self._plock:
-            for p in self._pending.values():
-                if p.event.is_set():
-                    continue
-                if p.frame is not None:
+            entries = list(self._pending.values())
+        for p in entries:
+            if p.event.is_set():
+                continue
+            if p.frame is not None:
+                try:
+                    self._direct_send(sock, p.frame)
+                except OSError:
                     self._sendq.push(p.frame, p.priority)
-                else:
-                    p.event.set()
+            else:
+                p.event.set()
 
     def _retain_push(self, key: str, rnd: int, frames: list,
                      priority: int) -> None:
@@ -635,6 +713,7 @@ class GeoPSClient:
             frame = msg.encode()
             if _verbose_level() >= 2:  # data-path sends log at ENQUEUE
                 _log_msg("ENQ ", msg, len(frame))
+            _ledger_push_hop(msg, len(frame) + 4)
             self._sendq.push(maybe_corrupt_frame(msg, frame), priority)
             return rid
         p = _Pending()
@@ -657,6 +736,7 @@ class GeoPSClient:
             p.frame, p.priority = frame, priority
         if frame_out is not None:
             frame_out.append(frame)
+        _ledger_push_hop(msg, len(frame) + 4)
         if self._reconnect and msg.type == MsgType.PUSH \
                 and msg.meta.get("round") is not None \
                 and msg.meta.get("chunk") is None:
@@ -804,7 +884,13 @@ class GeoPSClient:
                 Msg(MsgType.PUSH, key=key,
                     meta={"chunk": ch.index, "num_chunks": ch.num_chunks,
                           "start": ch.start, "n_total": int(g.size),
-                          "shape": list(g.shape), "round": rnd, **extra},
+                          "shape": list(g.shape), "round": rnd,
+                          # declared payload bytes for THIS chunk: the
+                          # ledger reconciles the sum against measured
+                          # frame bytes (P3 framing is overhead)
+                          "wire_declared":
+                              (ch.stop - ch.start) * g.dtype.itemsize,
+                          **extra},
                     array=flat[ch.start:ch.stop]),
                 priority=priority, frame_out=frames)
                 for ch in self._slicer.chunks(key, int(g.size), priority)]
@@ -816,6 +902,11 @@ class GeoPSClient:
             self._multi[mrid] = rids
             return mrid
         m.setdefault("round", rnd)
+        # the sender-declared wire cost: what the payload claims to be
+        # (for a pre-compressed pair push this IS the compressor's
+        # declared bytes) — the ledger's honesty ratio reconciles the
+        # measured frame bytes against it (docs/telemetry.md)
+        m.setdefault("wire_declared", int(g.nbytes))
         return self._submit(Msg(MsgType.PUSH, key=key, meta=m, array=g),
                             priority=priority)
 

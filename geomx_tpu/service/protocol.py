@@ -105,6 +105,33 @@ def wire_crc_errors() -> float:
 _ALLOWED_HEADER_TYPES = (str, int, float, bool, bytes, type(None), list,
                          tuple, dict)
 
+# frame kinds the fleet round ledger accounts (telemetry/ledger.py):
+# only round-tagged data traffic — control frames carry no round id
+_LEDGER_TYPES = frozenset((2, 4, 14))  # PUSH, PULL_REPLY, RELAY
+
+
+def _ledger_account(direction: str, msg: "Msg", nbytes: int) -> None:
+    """Byte-true wire accounting at the one encode/decode choke point
+    (docs/telemetry.md "Round ledger"): every producer ships
+    ``Msg.encode`` output verbatim (send_frame AND the pre-encoded
+    priority-queue paths) and every consumer parses via ``Msg.decode``,
+    so counting here measures the frame that actually crosses the
+    socket — P3 framing, pair codec, CRC prelude, pickled header and
+    the 4-byte length prefix included.  Best-effort: accounting must
+    never break the wire."""
+    meta = msg.meta
+    if msg.key is None or not meta or int(msg.type) not in _LEDGER_TYPES:
+        return
+    rid = meta.get("round")
+    if rid is None:
+        return
+    try:
+        from geomx_tpu.telemetry.ledger import account_frame
+        account_frame(direction, msg.type.name, msg.key, int(rid),
+                      int(nbytes), declared=meta.get("wire_declared"))
+    except Exception:
+        pass
+
 
 class MsgType(enum.IntEnum):
     INIT = 1
@@ -180,8 +207,12 @@ class Msg:
             payload = arr.tobytes()
         hb = pickle.dumps(header, protocol=4)
         body = _LEN.pack(len(hb)) + hb + payload
-        return (bytes((FRAME_VERSION,)) + _LEN.pack(zlib.crc32(body))
-                + body)
+        frame = (bytes((FRAME_VERSION,)) + _LEN.pack(zlib.crc32(body))
+                 + body)
+        # fleet round ledger (telemetry/ledger.py): +4 for the outer
+        # length prefix send_frame / the send loops add on the socket
+        _ledger_account("tx", self, len(frame) + 4)
+        return frame
 
     @classmethod
     def decode(cls, frame: bytes) -> "Msg":
@@ -214,8 +245,14 @@ class Msg:
             arr = np.frombuffer(frame[off + 4 + hlen:],
                                 dtype=np.dtype(header["dtype"]))
             arr = arr.reshape(header["shape"])
-        return cls(type=MsgType(header["t"]), key=header["k"],
-                   sender=header["s"], meta=header["m"], array=arr)
+        msg = cls(type=MsgType(header["t"]), key=header["k"],
+                  sender=header["s"], meta=header["m"], array=arr)
+        # receive-side wire accounting: unlike encode (once per frame
+        # construction), decode runs once per ARRIVAL, so retransmitted
+        # frames count here — the retry overhead the honesty audit
+        # exists to surface
+        _ledger_account("rx", msg, len(frame) + 4)
+        return msg
 
 
 # ---- fault injection (reference PS_DROP_MSG, van.cc:510-512: received
@@ -297,6 +334,17 @@ def maybe_corrupt_frame(msg: "Msg", frame: bytes) -> bytes:
     buf = bytearray(frame)
     i = _corrupt_rng.randrange(1, len(buf))
     buf[i] ^= 1 << _corrupt_rng.randrange(8)
+    if msg.key is not None and msg.meta.get("round") is not None:
+        # fleet round ledger: name the exact (key, round) hop this
+        # injected fault landed on — the receiver can only count an
+        # anonymous CRC rejection, the sender knows the victim
+        try:
+            from geomx_tpu.telemetry.ledger import CORRUPT, record_hop
+            record_hop(msg.key, int(msg.meta["round"]), CORRUPT,
+                       party=msg.sender,
+                       detail={"offset": i, "nbytes": len(buf)})
+        except Exception:
+            pass
     return bytes(buf)
 
 

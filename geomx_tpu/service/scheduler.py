@@ -472,62 +472,33 @@ class GeoScheduler:
 
     def _start_metrics_http(self, bind_host: str, port: int) -> None:
         """Serve ``GET /metrics`` (Prometheus text exposition of the
-        process-global registry) and ``GET /healthz`` (JSON liveness:
-        roster epoch, live parties, uptime) from a daemon HTTP thread."""
+        process-global registry), ``GET /healthz`` (JSON liveness:
+        roster epoch, live parties, uptime), ``GET /ledger`` (the
+        fleet round ledger, telemetry/ledger.py) and ``GET /control``
+        from a daemon HTTP thread — the shared exporter GeoPSServer's
+        ``GEOMX_SERVER_METRICS_PORT`` surface also runs."""
         import json as _json
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        sched = self
+        from geomx_tpu.telemetry.export import start_http_exporter
 
-        class _Handler(BaseHTTPRequestHandler):
-            def do_GET(h):
-                from geomx_tpu.telemetry import render_prometheus
-                from geomx_tpu.telemetry.export import CONTENT_TYPE
-                route = h.path.partition("?")[0].rstrip("/")
-                if route in ("", "/metrics"):
-                    body = render_prometheus().encode("utf-8")
-                    h.send_response(200)
-                    h.send_header("Content-Type", CONTENT_TYPE)
-                    h.send_header("Content-Length", str(len(body)))
-                    h.end_headers()
-                    h.wfile.write(body)
-                elif route == "/healthz":
-                    body = _json.dumps(
-                        sched.health_snapshot()).encode("utf-8")
-                    h.send_response(200)
-                    h.send_header("Content-Type", "application/json")
-                    h.send_header("Content-Length", str(len(body)))
-                    h.end_headers()
-                    h.wfile.write(body)
-                elif route == "/control":
-                    # Graft Pilot decision history (control/actuators.py,
-                    # docs/control.md): the bounded process-global log of
-                    # applied actuations — what the controller changed,
-                    # when, and why
-                    from geomx_tpu.control.actuators import \
-                        get_decision_log
-                    log = get_decision_log()
-                    body = _json.dumps({
-                        "decisions": log.snapshot(),
-                        "total": log.total,
-                        "capacity": log.capacity}).encode("utf-8")
-                    h.send_response(200)
-                    h.send_header("Content-Type", "application/json")
-                    h.send_header("Content-Length", str(len(body)))
-                    h.end_headers()
-                    h.wfile.write(body)
-                else:
-                    h.send_response(404)
-                    h.end_headers()
+        def _control():
+            # Graft Pilot decision history (control/actuators.py,
+            # docs/control.md): the bounded process-global log of
+            # applied actuations — what the controller changed,
+            # when, and why
+            from geomx_tpu.control.actuators import get_decision_log
+            log = get_decision_log()
+            return (_json.dumps({
+                "decisions": log.snapshot(),
+                "total": log.total,
+                "capacity": log.capacity}).encode("utf-8"),
+                "application/json")
 
-            def log_message(self, *args):  # no per-scrape stderr noise
-                pass
-
-        self._metrics_srv = ThreadingHTTPServer((bind_host, port), _Handler)
-        self._metrics_srv.daemon_threads = True
+        self._metrics_srv = start_http_exporter(
+            bind_host, port, health_fn=self.health_snapshot,
+            routes={"/control": _control},
+            thread_name="sched-metrics-http")
         self.metrics_port = self._metrics_srv.server_address[1]
-        threading.Thread(target=self._metrics_srv.serve_forever,
-                         name="sched-metrics-http", daemon=True).start()
 
     def start(self):
         self._thread.start()

@@ -194,6 +194,19 @@ class ShardedGlobalClient:
                 # the round died with the old incarnation: re-push it
                 # (idempotent under the per-sender round dedup if a
                 # durable copy survived after all)
+                try:
+                    # ledger: the failover is attributed to the exact
+                    # round it interrupted, on the named shard
+                    from geomx_tpu.telemetry.ledger import (
+                        FAILOVER_REPLAY, record_hop)
+                    record_hop(key, rnd, FAILOVER_REPLAY,
+                               party=self.sender_id, shard=idx,
+                               nbytes=int(grad.nbytes),
+                               detail={"map_version": self._map.version,
+                                       "addr_changed":
+                                       self._map.addr_of(idx) != old_addr})
+                except Exception:
+                    pass
                 c.push(key, grad, priority=prio,
                        meta={**meta, "round": rnd})
 
@@ -208,6 +221,24 @@ class ShardedGlobalClient:
             try:
                 return op(c)
             except WrongShardError as e:
+                # redirect observability (docs/telemetry.md): exactly
+                # one retry count per redirect, and a ledger hop naming
+                # the refusing shard + the map version it held — the
+                # round's record shows the re-route instead of a
+                # mystery latency bump
+                from geomx_tpu.service.retry import count_retry
+                count_retry("redirect")
+                try:
+                    from geomx_tpu.telemetry.ledger import (REDIRECT,
+                                                            record_hop)
+                    rnd = self._rounds.get(key)
+                    if rnd:
+                        record_hop(key, rnd, REDIRECT,
+                                   party=self.sender_id, shard=idx,
+                                   detail={"map_version":
+                                           int(e.map_version)})
+                except Exception:
+                    pass
                 want = max(int(e.map_version), self._map.version + 1)
                 try:
                     self.refresh_map(min_version=want, timeout=max(

@@ -37,6 +37,8 @@ from geomx_tpu.telemetry.flight import (FlightRecorder, flight_enabled,
                                         install_incident_recorder,
                                         notify_host_incident,
                                         uninstall_incident_recorder)
+from geomx_tpu.telemetry.ledger import (RoundLedger, get_round_ledger,
+                                        reset_round_ledger)
 from geomx_tpu.telemetry.links import (LinkObservatory,
                                        get_link_observatory,
                                        reset_link_observatory)
@@ -57,6 +59,7 @@ __all__ = [
     "publish_attribution",
     "roofline_record", "trainer_roofline", "publish_roofline",
     "LinkObservatory", "get_link_observatory", "reset_link_observatory",
+    "RoundLedger", "get_round_ledger", "reset_round_ledger",
     "FlightRecorder", "flight_enabled", "flight_recorder_from_config",
     "notify_host_incident", "install_incident_recorder",
     "uninstall_incident_recorder",

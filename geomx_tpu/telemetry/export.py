@@ -211,6 +211,74 @@ def parse_prometheus_text(text: str) -> Dict[str, Dict[str, Any]]:
 
 
 # ---------------------------------------------------------------------------
+# shared HTTP export surface (scheduler AND GeoPSServer serve the same
+# routes — PR 5 gave only the scheduler an HTTP port, so fleet scrapers
+# had to speak the wire protocol to reach a shard's registry)
+# ---------------------------------------------------------------------------
+
+def start_http_exporter(bind_host: str, port: int, health_fn=None,
+                        routes: Optional[Dict[str, Any]] = None,
+                        thread_name: str = "metrics-http"):
+    """Serve the standard observability routes from a daemon HTTP
+    thread: ``GET /metrics`` (Prometheus text exposition of the
+    process-global registry), ``GET /healthz`` (``health_fn()`` as
+    JSON), and ``GET /ledger`` (the process-global fleet round
+    ledger's records + summary, telemetry/ledger.py).  ``routes`` maps
+    extra paths to zero-arg callables returning ``(body_bytes,
+    content_type)`` (the scheduler adds ``/control``).  Returns the
+    ``ThreadingHTTPServer`` (``.server_address[1]`` is the bound port;
+    callers own ``shutdown()``/``server_close()``)."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    extra = dict(routes or {})
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(h):  # noqa: N805 — http.server handler convention
+            route = h.path.partition("?")[0].rstrip("/")
+            try:
+                if route in ("", "/metrics"):
+                    body = render_prometheus().encode("utf-8")
+                    ctype = CONTENT_TYPE
+                elif route == "/healthz" and health_fn is not None:
+                    body = _json.dumps(
+                        health_fn(), default=_json_default).encode("utf-8")
+                    ctype = "application/json"
+                elif route == "/ledger":
+                    from geomx_tpu.telemetry.ledger import get_round_ledger
+                    led = get_round_ledger()
+                    body = _json.dumps(
+                        {"records": led.records(),
+                         "summary": led.summary()},
+                        default=_json_default).encode("utf-8")
+                    ctype = "application/json"
+                elif route in extra:
+                    body, ctype = extra[route]()
+                else:
+                    h.send_response(404)
+                    h.end_headers()
+                    return
+            except Exception:
+                h.send_response(500)
+                h.end_headers()
+                return
+            h.send_response(200)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+
+        def log_message(self, *args):  # no per-scrape stderr noise
+            pass
+
+    srv = ThreadingHTTPServer((bind_host, port), _Handler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, name=thread_name,
+                     daemon=True).start()
+    return srv
+
+
+# ---------------------------------------------------------------------------
 # bounded JSONL structured event log
 # ---------------------------------------------------------------------------
 

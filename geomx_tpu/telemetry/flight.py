@@ -44,6 +44,8 @@ NONFINITE = "nonfinite_probe"
 GRAD_SPIKE = "grad_norm_spike"
 DENSITY_DRIFT = "density_drift"
 EXPOSED_JUMP = "exposed_comms_jump"
+STUCK_ROUND = "stuck_round"
+HONESTY_DRIFT = "honesty_ratio_drift"
 
 
 def _median(vals: List[float]) -> float:
@@ -75,6 +77,15 @@ class FlightRecorder:
     - ``exposed_jump``: exposed-comms fires when the fraction exceeds
       the rolling median by this *absolute* amount
       (GEOMX_FLIGHT_EXPOSED_JUMP);
+    - ``stuck_round_s``: the fleet-round-ledger rule — fires when the
+      oldest OPEN round (``ledger_open_round_age_s``, fed by
+      :meth:`record_ledger`) has been open longer than this
+      (GEOMX_FLIGHT_STUCK_S);
+    - ``honesty_drift``: fires when the per-round wire honesty ratio
+      (``wire_honesty_ratio``) moves more than this *relative*
+      fraction away from its rolling median — framing/retry overhead
+      quietly growing, or a compressor starting to lie
+      (GEOMX_FLIGHT_HONESTY_DRIFT);
     - ``min_history``: rolling rules stay quiet until this many prior
       records exist (a fresh run's first steps are not anomalies);
     - ``window``: how many trailing records feed the rolling median.
@@ -85,6 +96,8 @@ class FlightRecorder:
                  spike_factor: float = 10.0,
                  density_drift: float = 0.5,
                  exposed_jump: float = 0.25,
+                 stuck_round_s: float = 30.0,
+                 honesty_drift: float = 0.25,
                  min_history: int = 5,
                  window: int = 64,
                  decision_capacity: int = 64,
@@ -96,6 +109,8 @@ class FlightRecorder:
         self.spike_factor = float(spike_factor)
         self.density_drift = float(density_drift)
         self.exposed_jump = float(exposed_jump)
+        self.stuck_round_s = float(stuck_round_s)
+        self.honesty_drift = float(honesty_drift)
         self.min_history = int(min_history)
         self.window = int(window)
         self._ring: "collections.deque[dict]" = collections.deque(
@@ -146,6 +161,20 @@ class FlightRecorder:
             if self.dump_dir:
                 self.dumps.append(self.dump(fired, rec))
         return fired
+
+    def record_ledger(self, step: int, ledger=None,
+                      now: Optional[float] = None,
+                      **record_kw) -> List[dict]:
+        """Feed one fleet-round-ledger summary (telemetry/ledger.py)
+        through the ring as a probes record, so the ``stuck_round`` and
+        ``honesty_ratio_drift`` rules evaluate against the rolling
+        history exactly like every other rule.  ``ledger`` defaults to
+        the process-global one; ``now`` pins the staleness clock for
+        deterministic replays."""
+        if ledger is None:
+            from geomx_tpu.telemetry.ledger import get_round_ledger
+            ledger = get_round_ledger()
+        return self.record(step, ledger.summary(now=now), **record_kw)
 
     def snapshot(self) -> List[dict]:
         return list(self._ring)
@@ -245,6 +274,40 @@ class FlightRecorder:
                 fired.append({"rule": EXPOSED_JUMP, "step": rec["step"],
                               "exposed_fraction": exp,
                               "rolling_median": med, "jump": exp - med})
+
+        # 5. stuck round (fleet round ledger): an open round older than
+        # the bound — a shard that died without failover, a sender that
+        # will never satisfy the gate.  Immediate like the nonfinite
+        # rule: the age itself already encodes the history.
+        age = probes.get("ledger_open_round_age_s")
+        if age is not None:
+            try:
+                age = float(age)
+            except (TypeError, ValueError):
+                age = None
+        if age is not None and math.isfinite(age) \
+                and age > self.stuck_round_s:
+            fired.append({"rule": STUCK_ROUND, "step": rec["step"],
+                          "open_round_age_s": age,
+                          "open_rounds":
+                              probes.get("ledger_open_rounds"),
+                          "oldest_open":
+                              probes.get("ledger_oldest_open")})
+
+        # 6. honesty-ratio drift: measured-vs-declared wire bytes moved
+        # relative to the rolling median — framing/retry overhead
+        # creeping up, or a compressor's declared bytes going stale
+        hist = self._history("wire_honesty_ratio")
+        ratio = probes.get("wire_honesty_ratio")
+        if ratio is not None and len(hist) >= self.min_history:
+            med = _median(hist)
+            ratio = float(ratio)
+            if math.isfinite(ratio) and med > 0 and \
+                    abs(ratio - med) > self.honesty_drift * med:
+                fired.append({"rule": HONESTY_DRIFT, "step": rec["step"],
+                              "honesty_ratio": ratio,
+                              "rolling_median": med,
+                              "relative_drift": abs(ratio - med) / med})
         return fired
 
     # ---- forensics bundle --------------------------------------------------
@@ -372,4 +435,6 @@ def flight_recorder_from_config(config: Optional[Any] = None
         capacity=steps, dump_dir=dump_dir,
         spike_factor=_env(["GEOMX_FLIGHT_SPIKE"], 10.0, float),
         density_drift=_env(["GEOMX_FLIGHT_DENSITY_DRIFT"], 0.5, float),
-        exposed_jump=_env(["GEOMX_FLIGHT_EXPOSED_JUMP"], 0.25, float))
+        exposed_jump=_env(["GEOMX_FLIGHT_EXPOSED_JUMP"], 0.25, float),
+        stuck_round_s=_env(["GEOMX_FLIGHT_STUCK_S"], 30.0, float),
+        honesty_drift=_env(["GEOMX_FLIGHT_HONESTY_DRIFT"], 0.25, float))

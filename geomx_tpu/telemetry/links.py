@@ -173,6 +173,59 @@ class LinkObservatory:
                 folded += 1
         return folded
 
+    def ingest_ledger(self, records, peer: str = "global") -> int:
+        """Fold fleet-round-ledger records (``RoundLedger.records()``
+        dicts, telemetry/ledger.py) into the link estimators — the
+        on-wire-truth sensor path: unlike trace spans, ledger bytes
+        are measured at the wire choke point, so the Pilot's
+        throughput estimates see framing/retry overhead too.
+
+        Per record: every ``relay`` hop is one throughput+RTT
+        observation on its party's uplink; records WITHOUT a relay hop
+        (a flat worker->shard fleet) contribute one observation per
+        pushing party — that party's measured push bytes over the
+        push->merge interval, which is what the round actually waited.
+        Orphaned records count as one loss observation.  Timestamps
+        come from the hops, never the fold — same records, same
+        snapshot."""
+        folded = 0
+        for rec in records:
+            hops = rec.get("hops") or []
+            relays = [h for h in hops if h["hop"] == "relay"]
+            orphaned = rec.get("status") == "orphaned"
+            for h in relays:
+                p = h.get("party")
+                if p is None:
+                    p = rec.get("origin_party") or 0
+                self.observe(f"party{p}", peer,
+                             nbytes=float(h.get("nbytes") or 0.0),
+                             seconds=h.get("dur_s"), ok=not orphaned,
+                             t=h.get("t"))
+                folded += 1
+            if relays:
+                continue
+            merge = next((h for h in hops if h["hop"] == "merge"), None)
+            pushes: Dict[int, list] = {}
+            for h in hops:
+                if h["hop"] == "push" and h.get("party") is not None:
+                    pushes.setdefault(int(h["party"]), []).append(h)
+            for party, phops in sorted(pushes.items()):
+                nbytes = float(sum(h.get("nbytes") or 0 for h in phops))
+                t0 = min(h["t"] for h in phops)
+                seconds = None
+                if merge is not None and merge["t"] > t0:
+                    seconds = merge["t"] - t0
+                self.observe(f"party{party}", peer, nbytes=nbytes,
+                             seconds=seconds, ok=not orphaned,
+                             t=merge["t"] if merge is not None else t0)
+                folded += 1
+            if not pushes and orphaned:
+                self.observe(f"party{rec.get('origin_party') or 0}",
+                             peer, ok=False,
+                             t=rec.get("closed_unix"))
+                folded += 1
+        return folded
+
     # ---- read side (the controller's sensor interface) ---------------------
 
     def snapshot(self, now: Optional[float] = None,

@@ -296,8 +296,13 @@ def test_profiler_stable_thread_lanes_and_names(tmp_path):
 def test_profiler_concurrent_scope_dump_stress(tmp_path):
     """Writers recording scopes while a reader dumps repeatedly: every
     dump must be complete, parseable JSON (atomic temp+replace), and no
-    event may be torn."""
-    p = Profiler(filename=str(tmp_path / "stress.json"))
+    event may be torn.  The buffer is bounded small: the claim under
+    test is dump atomicity under concurrent writers, and the default
+    1M-event cap made the 20 full-buffer JSON serializations take
+    minutes of pure CPU on a small host (the writers spin as fast as
+    the GIL lets them) — a wall-clock burn, not extra coverage."""
+    p = Profiler(filename=str(tmp_path / "stress.json"),
+                 max_events=20_000)
     p.set_state(True)
     stop = threading.Event()
 

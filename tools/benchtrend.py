@@ -13,10 +13,14 @@ stall, lower is better), ``MANYPARTY_r*.json`` (the
 ``--compare-manyparty`` sharded-global-tier acceptance: bit-exactness /
 zero-lost-rounds / stall-bounded / failover / rebalance booleans plus
 the merge-throughput scaling ratio over shard count, higher is
-better) and ``SPARSEAGG_r*.json`` (the ``--compare-sparseagg``
+better), ``SPARSEAGG_r*.json`` (the ``--compare-sparseagg``
 compressed-domain aggregation acceptance: purity / bit-exactness /
 lattice booleans plus the bsc-vs-dense samples/sec ratio at the
-modeled multi-party topology, higher is better).
+modeled multi-party topology, higher is better) and
+``FLEETOBS_r*.json`` (the ``--compare-fleetobs`` fleet-round-ledger
+acceptance: gapless-ledger / byte-reconciliation / fault-attribution
+booleans plus the chaos-free p50/p99 round latency, lower is
+better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
 until a human re-read the numbers.  This tool makes the trajectory a
@@ -68,6 +72,8 @@ DIRECTION = {
     "vs_baseline": "up",
     "merge_throughput_scaling": "up",
     "sparse_vs_dense": "up",
+    "round_p99_s": "down",
+    "round_p50_s": "down",
 }
 
 
@@ -137,6 +143,26 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
             out["merge_throughput_scaling"] = float(thr["scaling"])
         # the raw stall is gated through stall_bounded — like the
         # RECOVERY series, the sub-minute absolute would flake a band
+        return out
+    if rec.get("mode") == "compare_fleetobs":  # FLEETOBS_r*
+        for gate in ("ok", "gapless_ledger", "zero_lost_rounds",
+                     "bytes_reconciled", "faults_attributed",
+                     "phase_histograms_ok", "trace_linked",
+                     "ledger_ingested"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        kp = rec.get("kill_probes")
+        if isinstance(kp, dict):
+            for which in ("inplace", "failover"):
+                sub = kp.get(which)
+                if isinstance(sub, dict) and "ok" in sub:
+                    out[f"kill_probe_{which}"] = bool(sub["ok"])
+        # round latency from the dedicated chaos-free run (lower is
+        # better); machine-sensitive like the throughput series — the
+        # band still catches a collapse
+        for m in ("round_p99_s", "round_p50_s"):
+            if isinstance(rec.get(m), (int, float)):
+                out[m] = float(rec[m])
         return out
     if rec.get("mode") == "compare_sparseagg":  # SPARSEAGG_r*
         for gate in ("ok", "sparse_beats_dense"):
@@ -264,7 +290,7 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
     patterns = patterns or ["BENCH_CAPTURED_r*.json", "BENCH_r*.json",
                             "MULTICHIP_r*.json", "CONTROL_r*.json",
                             "RECOVERY_r*.json", "MANYPARTY_r*.json",
-                            "SPARSEAGG_r*.json"]
+                            "SPARSEAGG_r*.json", "FLEETOBS_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     unreadable: List[str] = []
     for pat in patterns:
