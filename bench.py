@@ -3051,7 +3051,7 @@ def _compare_control(model_name: str = "mlp", batch: int = 48,
     }
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
-        from geomx_tpu.utils.fileio import atomic_json_dump
+        from geomx_tpu.utils.atomicio import atomic_json_dump
         atomic_json_dump(os.path.join(out_dir, "control_decisions.json"),
                          {"decisions": ctrl["decisions"],
                           "timeline": ctrl["timeline"],
@@ -3079,6 +3079,378 @@ def compare_control_main(argv):
         elif a.startswith("--out-dir="):
             kwargs["out_dir"] = a.split("=", 1)[1]
     _emit(_compare_control(**kwargs))
+
+
+# --------------------------------------------------------------------------
+# --compare-capsule: run capsules — whole-run capture, bit-exact offline
+# replay, and the fitted step-time cost model (docs/telemetry.md "Run
+# capsules", docs/performance.md "What-if search over capsules")
+# --------------------------------------------------------------------------
+
+def _capsule_pilot_factory(ratio_hi, ratio_bounds):
+    """The ONE policy-stack constructor the live run and the offline
+    replay share: identical constructor args + identical observations
+    = identical decision sequence (policies are deterministic)."""
+    from geomx_tpu.control import (DepthPolicy, GraftPilot, RatioPolicy,
+                                   RelayPolicy)
+
+    def factory(sensors):
+        return GraftPilot(
+            sensors,
+            ratio=RatioPolicy(ratio_hi, bounds=ratio_bounds, cooldown=3,
+                              deadband=0.2),
+            depth=DepthPolicy(enter=0.45, exit=0.40, confirm=2,
+                              cooldown=3),
+            relay=RelayPolicy(min_gain=2.0, cooldown=3,
+                              min_confidence=0.5))
+    return factory
+
+
+def _capsule_run(model_name: str, schedule_spec: str, steps: int,
+                 batch: int, compression: str, depth: int, wan_kw: dict,
+                 controller: bool = False, ratio_bounds=None,
+                 ratio_hi: float = None, capsule_path: str = None,
+                 sample_every: int = 10):
+    """One seeded 3-party replay on the chaos-shaped WAN clock (the
+    --compare-control harness), optionally recording a RunCapsule:
+    per-step sensor records + timing at the publish boundary, the link
+    journal via the observatory tap, periodic registry samples on the
+    virtual clock, the profiler trace, and (controller runs) the
+    decision log — everything the offline replay and the cost model
+    consume."""
+    import jax
+    import numpy as np
+    import optax
+
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.control import (ControlActuator, ControlSensors,
+                                   reset_decision_log)
+    from geomx_tpu.models import get_model
+    from geomx_tpu.resilience import ChaosEngine, ChaosSchedule
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.telemetry import (RunCapsule, reset_link_observatory,
+                                     reset_registry)
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+    from geomx_tpu.utils.profiler import get_profiler
+
+    P = 3
+    reset_registry()
+    observatory = reset_link_observatory()
+    log = reset_decision_log()
+    prof = get_profiler()
+
+    topo = HiPSTopology(num_parties=P, workers_per_party=1)
+    cfg = GeoConfig(num_parties=P, workers_per_party=1,
+                    compression=compression, bucket_bytes=1 << 20,
+                    pipeline_depth=depth, telemetry=True,
+                    control=controller)
+    sync = get_sync_algorithm(cfg)
+    net = get_model(model_name, num_classes=10)
+    trainer = Trainer(net, topo, optax.sgd(0.012), sync=sync,
+                      config=cfg, donate=False)
+    x, y = _control_make_data()
+    state = trainer.init_state(jax.random.PRNGKey(0), x[:2])
+    sharding = topo.batch_sharding(trainer.mesh)
+    local_b = batch // P
+
+    model = _WanModel(P, **wan_kw)
+    capsule = None
+    if capsule_path:
+        capsule = RunCapsule(
+            capsule_path, config=cfg,
+            extra_manifest={"wan": {k: float(v)
+                                    for k, v in wan_kw.items()},
+                            "schedule": schedule_spec,
+                            "compression": compression, "depth": depth})
+        capsule.attach_observatory(observatory)
+        # record the MODEL's parameter layout (abstract init), not the
+        # TrainState's party-stacked device arrays — the cost model's
+        # candidate wire accounting is per party per step
+        import jax.numpy as jnp
+        from jax.tree_util import keystr, tree_flatten_with_path
+        abstract = jax.eval_shape(
+            lambda: net.init(jax.random.PRNGKey(0),
+                             jnp.zeros((2, 32, 32, 3), jnp.uint8),
+                             train=False))
+        flat, _ = tree_flatten_with_path(dict(abstract)["params"])
+        capsule.set_param_shapes(
+            {keystr(path): {"shape": list(leaf.shape),
+                            "dtype": str(leaf.dtype)}
+             for path, leaf in flat})
+        prof.reset()
+        prof.set_state(True)
+
+    routes: tuple = ()
+    pilot = actuator = None
+    if controller:
+        sensors = ControlSensors(observatory=observatory,
+                                 min_confidence=0.5,
+                                 compute_s_fn=lambda s: model.compute_s)
+        pilot = _capsule_pilot_factory(ratio_hi, ratio_bounds)(sensors)
+
+        def relay_apply(order):
+            nonlocal routes
+            routes = tuple(int(p[5:]) for p in order)
+
+        actuator = ControlActuator(trainer=trainer,
+                                   relay_apply=relay_apply, log=log)
+
+    schedule = ChaosSchedule.from_spec(schedule_spec) \
+        if schedule_spec else ChaosSchedule.from_spec("seed=1")
+    clock = 0.0
+    timeline = []
+    with ChaosEngine(schedule, controller=None) as engine:
+        for it in range(steps):
+            engine.tick(it)
+            sel = (np.arange(batch) + it * batch) % len(x)
+            xb = jax.device_put(
+                x[sel].reshape(P, 1, local_b, 32, 32, 3), sharding)
+            yb = jax.device_put(y[sel].reshape(P, 1, local_b), sharding)
+            with prof.scope("train/step", "step", args={"step": it}):
+                with prof.scope("train/compute", "compute"):
+                    state, metrics = trainer.train_step(state, xb, yb)
+            telem = jax.device_get(metrics["telemetry"])
+            trainer._publish_telemetry(telem, it + 1)
+            emitted = float(telem.get("bsc_emitted_fraction", 1.0))
+            nbytes = float(telem["dc_wire_bytes"]) * emitted
+            rec = model.step_seconds(nbytes, trainer.control_depth(),
+                                     routes)
+            clock += rec["total"]
+            model.feed_observatory(observatory, nbytes, clock)
+            model.publish_phases(rec)
+            if capsule is not None:
+                # heartbeat-sized probe per uplink on a separate peer:
+                # invisible to the policies (they filter peer=="global")
+                # but it gives the cost model the second equation that
+                # separates link latency from bandwidth per step
+                # (telemetry/costmodel.fit_paired_link)
+                for p in range(P):
+                    observatory.observe(
+                        f"party{p}", "probe", nbytes=4096.0,
+                        seconds=model.uplink_seconds(p, 4096.0),
+                        t=clock)
+            timeline.append({
+                "step": it, "loss": float(metrics["loss"]),
+                "t": round(clock, 6), "total_s": rec["total"],
+                "wan_s": rec["wan"], "exposed_s": rec["exposed"],
+                "bytes": nbytes, "depth": trainer.control_depth()})
+            if capsule is not None:
+                capsule.record_step(
+                    it, t=clock,
+                    timing={"total_s": rec["total"],
+                            "compute_s": model.compute_s,
+                            "wan_s": rec["wan"],
+                            "exposed_s": rec["exposed"]},
+                    extra={"wire_bytes": nbytes})
+                if it % sample_every == 0 or it == steps - 1:
+                    capsule.sampler.sample(now=clock)
+            if pilot is not None:
+                for dec in pilot.tick(it, now=clock):
+                    state = actuator.apply(state, dec)
+    jax.block_until_ready(state.step)
+    live_snapshot = observatory.snapshot(now=clock)
+    out = {"timeline": timeline,
+           "decisions": log.snapshot() if controller else [],
+           "live_snapshot": live_snapshot,
+           "end_clock": clock,
+           "mean_step_s": sum(r["total_s"] for r in timeline)
+           / max(len(timeline), 1)}
+    if capsule is not None:
+        capsule.add_trace(prof.to_doc(), label="rank0")
+        prof.set_state(False)
+        out["capsule"] = capsule.write(now=clock)
+    return out
+
+
+def _compare_capsule(model_name: str = "mlp", batch: int = 48,
+                     steps: int = 48, schedule_spec: str = None,
+                     out_dir: str = None):
+    """The run-capsule acceptance (ISSUE 15): a 3-party CPU mesh under
+    a seeded chaos schedule proves (a) ONE capsule captures the run —
+    manifest, registry time series, step records, link journal, trace,
+    decisions; (b) offline replay reproduces the live LinkObservatory
+    snapshot AND the GraftPilot decision sequence bit-identically; (c)
+    the fitted step-time cost model ranks a 6-point ratio x depth x
+    compressor grid in the same order as measured step times, with
+    per-config relative error reported; (d) ``runcap explain`` on a
+    clean-vs-throttled capsule pair names the degraded link and the
+    phase that moved."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    if len(devs) < 3:
+        raise RuntimeError(
+            "compare-capsule needs >= 3 devices for the 3-party dc axis "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=3)")
+    out_dir = out_dir or "/tmp/geomx_capsule_bench"
+    os.makedirs(out_dir, exist_ok=True)
+
+    # byte-distinct grid levels: bsc pairs cost 8 B/emitted element, so
+    # ratio 0.125 = 1 B/elem and 0.015625 = 0.125 B/elem sit clear of
+    # fp16's 2 B/elem — no two configs tie on wire bytes
+    ratio_hi = 0.125
+    ratio_lo = ratio_hi / 8.0
+    if schedule_spec is None:
+        # party 1's uplink degrades 8x (+150 ms) for the middle of the
+        # run: the capsule must record the degradation, the replay must
+        # reproduce the controller's response to it, and the cost model
+        # must price it into every candidate at the steps it covered
+        schedule_spec = ("seed=77;throttle@4:party=1,factor=0.125,"
+                        "steps=24;delay@4:party=1,ms=150,steps=24")
+    compute_s = 0.05
+    wan_kw = dict(base_bps=0.0, p2p_bps=0.0, base_delay_s=0.01,
+                  compute_s=compute_s)
+    from geomx_tpu.compression.bisparse import BiSparseCompressor
+    from geomx_tpu.compression.bucketing import BucketedCompressor
+    from geomx_tpu.models import get_model
+    probe_model = get_model(model_name, num_classes=10)
+    variables = jax.eval_shape(
+        lambda: probe_model.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((2, 32, 32, 3), jnp.uint8),
+                                 train=False))
+    params_shapes = dict(variables)["params"]
+    comp = BucketedCompressor(BiSparseCompressor(ratio=ratio_hi),
+                              bucket_bytes=1 << 20)
+    hi_bytes = float(comp.wire_bytes(params_shapes))
+    wan_kw["base_bps"] = hi_bytes / (0.1 * compute_s)
+    wan_kw["p2p_bps"] = 8.0 * wan_kw["base_bps"]
+    bounds = (ratio_lo, ratio_hi)
+
+    # ---- (a)+(b): the controller capsule + bit-exact offline replay
+    cap_a_path = os.path.join(out_dir, "capsule_controller.json")
+    ctrl = _capsule_run(model_name, schedule_spec, steps, batch,
+                        f"bsc,{ratio_hi}", 0, wan_kw, controller=True,
+                        ratio_bounds=bounds, ratio_hi=ratio_hi,
+                        capsule_path=cap_a_path)
+    from geomx_tpu.telemetry import Capsule, StepTimeCostModel
+    cap_a = Capsule.load(cap_a_path)
+    manifest_ok = all(
+        cap_a.manifest.get(k) for k in
+        ("kind", "version", "config", "env", "build", "observatory",
+         "param_shapes")) and bool(cap_a.registry_samples) \
+        and len(cap_a.steps) == steps and bool(cap_a.traces) \
+        and bool(cap_a.decisions) \
+        and cap_a.manifest.get("journal_dropped", 1) == 0 \
+        and cap_a.manifest.get("steps_dropped", 1) == 0
+    replay_snap = cap_a.link_snapshot(now=ctrl["end_clock"])
+    snap_identical = (json.dumps(replay_snap, sort_keys=True)
+                      == json.dumps(ctrl["live_snapshot"],
+                                    sort_keys=True))
+    replay_decs = cap_a.replay_decisions(
+        _capsule_pilot_factory(ratio_hi, bounds), min_confidence=0.5,
+        compute_s_fn=lambda s: compute_s)
+    decs_identical = (json.dumps(replay_decs, sort_keys=True)
+                      == json.dumps(ctrl["decisions"], sort_keys=True))
+
+    # ---- (c): cost model fitted from the capsule vs measured grid
+    cost_model = StepTimeCostModel.fit(cap_a)
+    grid = {
+        "bsc_hi_d0": (f"bsc,{ratio_hi}", 0),
+        "bsc_hi_d1": (f"bsc,{ratio_hi}", 1),
+        "bsc_lo_d0": (f"bsc,{ratio_lo}", 0),
+        "bsc_lo_d1": (f"bsc,{ratio_lo}", 1),
+        "fp16_d0": ("fp16", 0),
+        "fp16_d1": ("fp16", 1),
+    }
+    cap_b_path = os.path.join(out_dir, "capsule_throttled.json")
+    grid_out = {}
+    for name, (spec, d) in grid.items():
+        run = _capsule_run(
+            model_name, schedule_spec, steps, batch, spec, d, wan_kw,
+            capsule_path=cap_b_path if name == "bsc_hi_d0" else None)
+        pred = cost_model.predict({"compression": spec, "depth": d,
+                                   "bucket_bytes": 1 << 20})
+        measured = run["mean_step_s"]
+        grid_out[name] = {
+            "compression": spec, "depth": d,
+            "measured_step_s": round(measured, 6),
+            "predicted_step_s": round(pred["mean_step_s"], 6),
+            "predicted_wire_bytes": pred["wire_bytes"],
+            "rel_error": round(
+                abs(pred["mean_step_s"] - measured) / measured, 4),
+        }
+    measured_order = sorted(grid_out,
+                            key=lambda n: grid_out[n]["measured_step_s"])
+    predicted_order = sorted(
+        grid_out, key=lambda n: grid_out[n]["predicted_step_s"])
+    rank_exact = measured_order == predicted_order
+    max_rel_err = max(g["rel_error"] for g in grid_out.values())
+
+    # ---- (d): runcap explain names the injected degradation
+    cap_c_path = os.path.join(out_dir, "capsule_clean.json")
+    _capsule_run(model_name, "", steps, batch, f"bsc,{ratio_hi}", 0,
+                 wan_kw, capsule_path=cap_c_path)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        import runcap
+    finally:
+        sys.path.pop(0)
+    findings = runcap.explain_docs(runcap.load_doc(cap_c_path),
+                                   runcap.load_doc(cap_b_path))
+    names_link = any(
+        f["kind"] == "link" and "party1" in f["name"]
+        and (f["metric"] == "throughput_bps" or f["metric"] == "rtt_s")
+        for f in findings)
+    names_phase = any(f["kind"] == "phase"
+                      and f["name"] == "exposed_comms"
+                      for f in findings)
+
+    out = {
+        "mode": "compare_capsule",
+        "model": model_name, "batch": batch, "steps": steps,
+        "parties": 3,
+        "schedule": schedule_spec,
+        "wan": {k: round(float(v), 6) for k, v in wan_kw.items()},
+        "capsule_recorded": bool(manifest_ok),
+        "capsule_sections": {
+            "steps": len(cap_a.steps),
+            "link_observations": len(cap_a.link_journal),
+            "registry_samples": len(cap_a.registry_samples),
+            "traces": len(cap_a.traces),
+            "decisions": len(cap_a.decisions),
+            "events": len(cap_a.events),
+        },
+        "replay_snapshot_bit_identical": bool(snap_identical),
+        "replay_decisions_bit_identical": bool(decs_identical),
+        "decision_count": len(ctrl["decisions"]),
+        "cost_model": cost_model.to_json(),
+        "grid": grid_out,
+        "measured_order": measured_order,
+        "predicted_order": predicted_order,
+        "cost_model_rank_exact": bool(rank_exact),
+        "cost_model_max_rel_err": round(max_rel_err, 4),
+        "cost_model_error_bounded": bool(max_rel_err <= 0.35),
+        "explain_findings": [f["text"] for f in findings],
+        "explain_names_degraded_link": bool(names_link),
+        "explain_names_phase": bool(names_phase),
+        "artifacts": {"capsule_controller": cap_a_path,
+                      "capsule_throttled": cap_b_path,
+                      "capsule_clean": cap_c_path},
+    }
+    out["ok"] = all(out[k] for k in (
+        "capsule_recorded", "replay_snapshot_bit_identical",
+        "replay_decisions_bit_identical", "cost_model_rank_exact",
+        "cost_model_error_bounded", "explain_names_degraded_link",
+        "explain_names_phase"))
+    return out
+
+
+def compare_capsule_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--steps="):
+            kwargs["steps"] = int(a.split("=", 1)[1])
+        elif a.startswith("--schedule="):
+            kwargs["schedule_spec"] = a.split("=", 1)[1]
+        elif a.startswith("--out-dir="):
+            kwargs["out_dir"] = a.split("=", 1)[1]
+    _emit(_compare_capsule(**kwargs))
 
 
 # --------------------------------------------------------------------------
@@ -5321,6 +5693,17 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=3").strip()
         compare_control_main(sys.argv[1:])
+    elif "--compare-capsule" in sys.argv:
+        # run-capsule acceptance: whole-run capture + bit-exact offline
+        # replay + fitted cost model, on the --compare-control 3-party
+        # CPU mesh (3 devices, env before the first jax import)
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=3").strip()
+        compare_capsule_main(sys.argv[1:])
     elif "--compare-recovery" in sys.argv:
         # host-plane recovery acceptance: pure service-plane (sockets +
         # numpy), no jax mesh — runs anywhere in seconds

@@ -149,6 +149,16 @@ class GeoConfig:
     flight_steps: int = 0         # ring capacity; 0 = default 256
     flight_dir: str = ""          # bundle dir; "" = ./geomx_flight
 
+    # ---- run capsules (telemetry/capsule.py; docs/telemetry.md "Run
+    # capsules"): record the run's whole observability state — manifest,
+    # registry time series, per-step sensor records, link journal,
+    # traces, event log, round ledger, decision log — into ONE
+    # versioned atomically-written archive that replays offline
+    # bit-identically (tools/runcap.py reads it).  Off by default.
+    capsule: bool = False
+    capsule_dir: str = ""          # archive dir; "" = ./geomx_capsule
+    capsule_sample_s: float = 0.0  # registry sampling cadence; 0 = 10 s
+
     # ---- static analysis (analysis/: the Graft Auditor; docs/analysis.md)
     # Off by default.  When on, the Trainer checks the collective
     # signature of every membership-recompiled step program against the
@@ -239,6 +249,10 @@ class GeoConfig:
             flight_steps=_env(["GEOMX_FLIGHT_STEPS"], 0,
                               lambda s: int(float(s))),
             flight_dir=_env(["GEOMX_FLIGHT_DIR"], "", str),
+            capsule=_env_bool(["GEOMX_CAPSULE"], False),
+            capsule_dir=_env(["GEOMX_CAPSULE_DIR"], "", str),
+            capsule_sample_s=_env(["GEOMX_CAPSULE_SAMPLE_S"], 0.0,
+                                  float),
             audit=_env_bool(["GEOMX_AUDIT"], False),
             audit_severity=_env(["GEOMX_AUDIT_SEVERITY"], "error", str),
             control=_env_bool(["GEOMX_CONTROL"], False),

@@ -93,16 +93,22 @@ class ControlSensors:
     (links below it are invisible to every policy).  ``compute_s_fn``:
     optional callable ``step -> seconds`` supplying absolute compute
     time when the host knows it (bench's WAN model; a profiler-derived
-    estimate in live runs).
+    estimate in live runs).  ``registry_fn``: the REPLAY path
+    (telemetry/capsule.py) — a callable ``step -> registry-like``
+    serving the registry view recorded AT that step, so an offline
+    re-tick over a run capsule reads exactly what the live tick read;
+    takes precedence over ``registry``.
     """
 
     def __init__(self, observatory=None, registry=None, liveness=None,
-                 min_confidence: float = 0.5, compute_s_fn=None):
+                 min_confidence: float = 0.5, compute_s_fn=None,
+                 registry_fn=None):
         self.observatory = observatory
         self.registry = registry
         self.liveness = liveness
         self.min_confidence = float(min_confidence)
         self.compute_s_fn = compute_s_fn
+        self.registry_fn = registry_fn
 
     def _observatory(self):
         if self.observatory is not None:
@@ -123,7 +129,8 @@ class ControlSensors:
         time, not wall time)."""
         links = self._observatory().snapshot(
             now=now, min_confidence=self.min_confidence)
-        reg = self._registry()
+        reg = self.registry_fn(step) if self.registry_fn is not None \
+            else self._registry()
         probes = _gauge_values(reg, "geomx_step_probe")
         phases = _gauge_values(reg, "geomx_phase_fraction")
         fields: Dict[str, Optional[float]] = {}

@@ -57,27 +57,14 @@ class DurabilityError(RuntimeError):
 
 
 def _atomic_write(path: str, data: bytes) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    # fsync the DIRECTORY so the rename itself is durable before any
-    # dependent mutation proceeds — compact() truncates the journal
-    # right after the snapshot replace, and without this a power loss
+    # the shared atomic-replace owner (utils/atomicio.py) with
+    # fsync=True: data fsynced before the rename, the DIRECTORY fsynced
+    # after it — compact() truncates the journal right after the
+    # snapshot replace, and without the directory fsync a power loss
     # could persist the truncation but not the rename, losing every
     # record since the previous snapshot
-    try:
-        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
-    except OSError:
-        return  # e.g. platforms without directory fds: best effort
-    try:
-        os.fsync(dfd)
-    except OSError:
-        pass
-    finally:
-        os.close(dfd)
+    from geomx_tpu.utils.atomicio import atomic_write_bytes
+    atomic_write_bytes(path, data, fsync=True)
 
 
 class DurableStateStore:
@@ -92,6 +79,11 @@ class DurableStateStore:
         self.directory = str(directory)
         self.name = str(name)
         os.makedirs(self.directory, exist_ok=True)
+        # a SIGKILL between mkstemp and the rename leaves a uniquely
+        # named orphan temp; the restart (this constructor) is the one
+        # place that can reclaim it without racing a live writer
+        from geomx_tpu.utils.atomicio import sweep_stale_tmp
+        sweep_stale_tmp(self.directory)
         self._snap_path = os.path.join(self.directory, name + ".snap")
         self._journal_path = os.path.join(self.directory, name + ".journal")
         self._gen_path = os.path.join(self.directory, name + ".gen")

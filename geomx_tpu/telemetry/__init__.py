@@ -23,12 +23,27 @@ Interpretation (the step-time observatory, built on the sensors):
   from replayed WAN round spans (:class:`LinkObservatory`);
 - :mod:`flight` — bounded per-step flight recorder with deterministic
   anomaly rules and forensics bundles (``GEOMX_FLIGHT``).
+
+Whole-run capture (built on all of the above):
+
+- :mod:`capsule` — run capsules: one versioned archive of the whole
+  observability state with bit-exact offline replay
+  (``GEOMX_CAPSULE``, ``tools/runcap.py``);
+- :mod:`costmodel` — a step-time cost model fitted from capsule
+  records for offline what-if search over candidate configs.
 """
 
 from geomx_tpu.telemetry.attribution import (attribute_merged,
                                              attribute_trace,
                                              classify_span,
                                              publish_attribution)
+from geomx_tpu.telemetry.capsule import (Capsule, RegistrySampler,
+                                         RunCapsule, capsule_enabled,
+                                         capsule_from_config,
+                                         sample_registry)
+from geomx_tpu.telemetry.costmodel import (StepTimeCostModel,
+                                           candidate_wire_bytes,
+                                           fit_affine_link)
 from geomx_tpu.telemetry.export import (EventLog, get_event_log, log_event,
                                         parse_prometheus_text,
                                         render_prometheus)
@@ -63,4 +78,7 @@ __all__ = [
     "FlightRecorder", "flight_enabled", "flight_recorder_from_config",
     "notify_host_incident", "install_incident_recorder",
     "uninstall_incident_recorder",
+    "RunCapsule", "Capsule", "RegistrySampler", "sample_registry",
+    "capsule_enabled", "capsule_from_config",
+    "StepTimeCostModel", "fit_affine_link", "candidate_wire_bytes",
 ]

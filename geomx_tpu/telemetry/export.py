@@ -310,10 +310,33 @@ class EventLog:
         self._lock = threading.Lock()
         self.write_errors = 0
         self.rotations = 0
+        self.dropped_records = 0
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._size = os.path.getsize(path) if os.path.exists(path) else 0
+        # per-generation record counts, tracked IN MEMORY so a rotation
+        # never reads a generation file back while holding the emit
+        # lock (the one-time init scan of pre-existing files is the
+        # only read).  _rot1_records is what the NEXT rotation loses.
+        self._gen_records = self._count_records(path)
+        self._rot1_records = self._count_records(path + ".1")
+
+    @staticmethod
+    def _count_records(path: str) -> int:
+        """Newline count of a generation file (one record per line) —
+        used only at construction to adopt pre-existing generations.
+        Bounded by max_bytes, so the read is bounded too."""
+        n = 0
+        try:
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        return n
+                    n += chunk.count(b"\n")
+        except OSError:
+            return 0
 
     def emit(self, kind: str, **fields) -> None:
         rec = {"ts": round(time.time(), 6), "kind": kind}
@@ -330,6 +353,11 @@ class EventLog:
         data = line.encode("utf-8")
         with self._lock:
             if self._size + len(data) > self.max_bytes:
+                # the outgoing .1 generation's records are about to be
+                # discarded by the replace below — count the loss
+                # (tracked in memory; no file read under this lock)
+                # instead of silently dropping the tail of history
+                lost = self._rot1_records
                 try:
                     os.replace(self.path, self.path + ".1")
                 except OSError:
@@ -341,16 +369,25 @@ class EventLog:
                     return
                 self._size = 0
                 self.rotations += 1
+                self.dropped_records += lost
+                self._rot1_records = self._gen_records
+                self._gen_records = 0
                 # a rotation discards a generation of history — publish
                 # it so operators learn about the loss from a scrape,
                 # not from a forensics dead end (best-effort like the
                 # write itself: a foreign schema conflict on the name
                 # must not take down the subsystem being observed)
                 try:
-                    get_registry().counter(
+                    reg = get_registry()
+                    reg.counter(
                         "geomx_eventlog_rotations_total",
                         "Event-log rotations (each discards the "
                         "previous rotated generation)").inc()
+                    if lost:
+                        reg.counter(
+                            "geomx_eventlog_dropped_records_total",
+                            "Event records lost when rotation discarded "
+                            "the previous generation").inc(lost)
                 except ValueError:
                     pass
                 marker = json.dumps({"ts": rec["ts"],
@@ -363,6 +400,7 @@ class EventLog:
                 self.write_errors += 1
                 return
             self._size += len(data)
+            self._gen_records += data.count(b"\n")
 
     def read(self) -> List[dict]:
         """Parse the current generation back (tests/diagnostics)."""

@@ -325,6 +325,18 @@ class FlightRecorder:
                 self.dump_dir, f"flight_step{rec['step']}_{rule}.json")
         poisoned = sorted({p for f in fired
                            for p in f.get("poisoned_parties", [])})
+        # the counter/gauge state AT dump time: step records say what
+        # the run published per step, but the registry holds the
+        # cumulative truth (restart counters, CRC rejections, eviction
+        # totals) a forensics read needs next to them.  Bounded by the
+        # same size discipline as the ring: at most `capacity` children
+        # per family, dropped children counted in the sample itself.
+        try:
+            from geomx_tpu.telemetry.capsule import sample_registry
+            registry_section = sample_registry(
+                max_children_per_family=self.capacity)
+        except Exception:
+            registry_section = {}
         bundle = {
             "kind": "geomx_flight_bundle",
             "written_unix": round(time.time(), 6),
@@ -335,9 +347,10 @@ class FlightRecorder:
             "ring": self.snapshot(),
             "decisions": self.decisions(),
             "incidents": self.incidents(),
+            "registry": registry_section,
             "capacity": self.capacity,
         }
-        from geomx_tpu.utils.fileio import atomic_json_dump
+        from geomx_tpu.utils.atomicio import atomic_json_dump
         return atomic_json_dump(path, bundle)
 
 

@@ -110,6 +110,21 @@ class LinkObservatory:
         self.stale_after_s = float(stale_after_s)
         self._lock = threading.Lock()
         self._links: Dict[Tuple[str, str], LinkEstimate] = {}
+        self._tap = None
+
+    def set_tap(self, fn) -> None:
+        """Install (or clear, with None) an observation tap: ``fn``
+        receives every :meth:`observe` call as one plain dict
+        ``{party, peer, nbytes, seconds, ok, t}`` with the RESOLVED
+        timestamp.  The run-capsule recorder
+        (:mod:`geomx_tpu.telemetry.capsule`) uses this as its link
+        journal; replaying the journal through a fresh observatory in
+        order reproduces the EWMA state bit-identically.  The tap is
+        called under the observatory lock so journal order always
+        equals fold order — it must be cheap and non-blocking (a list
+        append)."""
+        with self._lock:
+            self._tap = fn
 
     # ---- write side --------------------------------------------------------
 
@@ -125,6 +140,12 @@ class LinkObservatory:
         t = time.time() if t is None else float(t)
         key = (str(party), str(peer))
         with self._lock:
+            if self._tap is not None:
+                self._tap({
+                    "party": key[0], "peer": key[1],
+                    "nbytes": float(nbytes),
+                    "seconds": None if seconds is None else float(seconds),
+                    "ok": bool(ok), "t": t})
             est = self._links.get(key)
             if est is None:
                 est = self._links[key] = LinkEstimate()

@@ -160,6 +160,34 @@ class Trainer:
                 "recorder rides the in-graph step probes — enable "
                 "GEOMX_TELEMETRY/GeoConfig(telemetry=True) or the ring "
                 "records nothing", RuntimeWarning, stacklevel=2)
+        # run capsule (telemetry/capsule.py, GEOMX_CAPSULE): whole-run
+        # observability capture — per-step sensor records at the same
+        # publish boundary as the flight ring, the link journal via the
+        # observatory tap, periodic registry samples, and the archive
+        # written at every fit end (atomic; tools/runcap.py reads it)
+        from geomx_tpu.telemetry.capsule import capsule_from_config
+        self._capsule = capsule_from_config(self.config)
+        if self._capsule is not None:
+            from geomx_tpu.telemetry.links import get_link_observatory
+            self._capsule.attach_observatory(get_link_observatory())
+            self._capsule.sampler.start()
+            # the sampler thread and the observatory tap must not
+            # outlive the trainer: a process constructing many
+            # capsule-armed trainers (repeated experiments, notebooks)
+            # would otherwise leak one registry-walking daemon each.
+            # The finalizer holds the capsule, never the trainer —
+            # close_capsule() is the deterministic path.
+            import weakref
+            weakref.finalize(self, self._capsule.sampler.stop)
+            weakref.finalize(self, self._capsule.detach_observatory)
+            if not self._telemetry:
+                import warnings
+                warnings.warn(
+                    "GEOMX_CAPSULE is on but telemetry is off: the "
+                    "capsule's step records ride the published probes "
+                    "— enable GEOMX_TELEMETRY/GeoConfig(telemetry="
+                    "True) or the archive captures no sensor stream",
+                    RuntimeWarning, stacklevel=2)
         self._event_log = None
         events_path = getattr(self.config, "telemetry_events", "")
         if events_path:
@@ -839,6 +867,11 @@ class Trainer:
                                  **flat)
         else:
             log_event("step_probes", iteration=iteration, **flat)
+        if self._capsule is not None:
+            # record the sensor surface the way a control tick reads it
+            # (registry gauge families) — what makes the capsule's
+            # replayed observation stream bit-identical to the live one
+            self._capsule.record_step(iteration)
         if self._flight is not None:
             fired = self._flight.record(
                 iteration, flat,
@@ -1129,6 +1162,7 @@ class Trainer:
                     rec = measure.add(epoch=epoch, iteration=it, **fields)
                     log_fn(json.dumps(rec))
             jax.block_until_ready(state.step)
+            self._capsule_checkpoint(prof)
             return state, measure.records
         # Virtual CPU meshes deadlock XLA's collective rendezvous with more
         # than a few in-flight async programs, so there we consume metrics
@@ -1195,4 +1229,28 @@ class Trainer:
             att = attribute_trace(prof.to_doc(), since_us=fit_since_us)
             if att["num_steps"]:
                 publish_attribution(att["summary"])
+        self._capsule_checkpoint(prof)
         return state, measure.records
+
+    def _capsule_checkpoint(self, prof) -> None:
+        """Refresh the run capsule at a fit boundary: attach the
+        latest profiler trace (replacing this rank's previous one) and
+        rewrite the archive atomically.  A crash between fits leaves
+        the previous complete capsule."""
+        if self._capsule is None:
+            return
+        if prof.running:
+            # Profiler() defaults self.rank = None — the getattr
+            # fallback alone never applies, hence the `or 0`
+            rank = getattr(prof, "rank", None)
+            self._capsule.add_trace(prof.to_doc(),
+                                    label=f"rank{rank if rank is not None else 0}")
+        self._capsule.write()
+
+    def close_capsule(self) -> None:
+        """Deterministically finish capsule recording: stop the
+        sampler, detach the observatory tap and write the final
+        archive.  (A garbage-collected trainer stops its sampler/tap
+        via finalizers, but does not write.)"""
+        if self._capsule is not None:
+            self._capsule.close()

@@ -89,10 +89,10 @@ def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
         path = os.path.join(path, f"step_{step}")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     final = path if path.endswith(".ckpt") else path + ".ckpt"
-    tmp = final + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(tree_to_bytes(state, meta=meta))
-    os.replace(tmp, final)  # a crash mid-write never corrupts a checkpoint
+    from geomx_tpu.utils.atomicio import atomic_write_bytes
+    # a crash mid-write never corrupts a checkpoint; fsync so a resume
+    # after power loss never reads a rename that didn't survive
+    atomic_write_bytes(final, tree_to_bytes(state, meta=meta), fsync=True)
     return final
 
 

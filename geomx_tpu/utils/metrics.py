@@ -79,7 +79,7 @@ class Measure:
         path = path or self.output_path
         if not path:
             raise ValueError("no output path")
-        from geomx_tpu.utils.fileio import atomic_json_dump
+        from geomx_tpu.utils.atomicio import atomic_json_dump
         return atomic_json_dump(path, {"records": self.records,
                                        "summary": self.summary()},
                                 indent=2)

@@ -250,7 +250,7 @@ class Profiler:
         ``metadata.num_events``/``num_spans``/``dropped_events`` record
         the trace's own span accounting (``to_doc``)."""
         path = path or self._dump_path()
-        from geomx_tpu.utils.fileio import atomic_json_dump
+        from geomx_tpu.utils.atomicio import atomic_json_dump
         return atomic_json_dump(path, self.to_doc())
 
     def aggregate_stats(self) -> Dict[str, Dict[str, float]]:

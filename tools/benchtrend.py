@@ -20,6 +20,9 @@ modeled multi-party topology, higher is better) and
 ``FLEETOBS_r*.json`` (the ``--compare-fleetobs`` fleet-round-ledger
 acceptance: gapless-ledger / byte-reconciliation / fault-attribution
 booleans plus the chaos-free p50/p99 round latency, lower is
+better), and ``CAPSULE_r*.json`` (the ``--compare-capsule`` run-capsule
+acceptance: capture / replay-fidelity / cost-model-accuracy booleans
+plus the cost model's max per-config relative error, lower is
 better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
@@ -74,6 +77,7 @@ DIRECTION = {
     "sparse_vs_dense": "up",
     "round_p99_s": "down",
     "round_p50_s": "down",
+    "cost_model_max_rel_err": "down",
 }
 
 
@@ -191,6 +195,20 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
         if isinstance(dev, dict) and dev.get("device_kind"):
             out["device_kind"] = dev["device_kind"]
         return out
+    if rec.get("mode") == "compare_capsule":  # CAPSULE_r*
+        for gate in ("ok", "capsule_recorded",
+                     "replay_snapshot_bit_identical",
+                     "replay_decisions_bit_identical",
+                     "cost_model_rank_exact",
+                     "cost_model_error_bounded",
+                     "explain_names_degraded_link",
+                     "explain_names_phase"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        if isinstance(rec.get("cost_model_max_rel_err"), (int, float)):
+            out["cost_model_max_rel_err"] = \
+                float(rec["cost_model_max_rel_err"])
+        return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
                      "decision_log_deterministic",
@@ -285,13 +303,54 @@ def compare_series(runs: List[Tuple[str, Dict[str, Any]]],
     return verdicts
 
 
+def _capsule_path(doc: dict, repo_dir: str) -> Optional[str]:
+    """A run capsule referenced by a series record, if its file is
+    reachable: ``capsule`` / ``artifacts.capsule`` /
+    ``artifacts.capsule_controller`` on the record (or its driver
+    ``parsed`` wrapper), resolved against ``repo_dir``."""
+    for rec in (doc, doc.get("parsed") or {}):
+        if not isinstance(rec, dict):
+            continue
+        art = rec.get("artifacts") or {}
+        path = rec.get("capsule") or art.get("capsule") \
+            or art.get("capsule_controller")
+        if not path:
+            continue
+        for cand in (path, os.path.join(repo_dir, path)):
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def _explain_capsules(prev_path: str, last_path: str) -> List[dict]:
+    """Best-effort ``runcap explain`` between the two runs' capsules —
+    the regression report NAMES the phase fraction, link estimate or
+    honesty ratio that moved instead of just flipping red.  runcap's
+    diff/explain helpers are stdlib-only by contract, so importing the
+    sibling module keeps this tool repo-import-free."""
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_benchtrend_runcap",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "runcap.py"))
+        runcap = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(runcap)
+        return runcap.explain_docs(runcap.load_doc(prev_path),
+                                   runcap.load_doc(last_path))
+    except Exception:
+        return []
+
+
 def run(repo_dir: str, band: float = DEFAULT_BAND,
         patterns: Optional[List[str]] = None) -> dict:
     patterns = patterns or ["BENCH_CAPTURED_r*.json", "BENCH_r*.json",
                             "MULTICHIP_r*.json", "CONTROL_r*.json",
                             "RECOVERY_r*.json", "MANYPARTY_r*.json",
-                            "SPARSEAGG_r*.json", "FLEETOBS_r*.json"]
+                            "SPARSEAGG_r*.json", "FLEETOBS_r*.json",
+                            "CAPSULE_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+    raw_docs: Dict[str, List[dict]] = {}
     unreadable: List[str] = []
     for pat in patterns:
         for path in sorted(glob.glob(os.path.join(repo_dir, pat)),
@@ -303,13 +362,23 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
                 continue
             series.setdefault(name, []).append(
                 (os.path.basename(path), extract_metrics(doc)))
+            raw_docs.setdefault(name, []).append(doc)
     all_verdicts: Dict[str, List[dict]] = {}
     regressions: List[dict] = []
+    capsule_explain: Dict[str, List[dict]] = {}
     for name, runs_ in sorted(series.items()):
         verdicts = compare_series(runs_, band)
         all_verdicts[name] = verdicts
-        regressions.extend(v for v in verdicts
-                           if v["status"] == "regression")
+        series_regressions = [v for v in verdicts
+                              if v["status"] == "regression"]
+        regressions.extend(series_regressions)
+        if series_regressions and len(raw_docs.get(name, [])) >= 2:
+            prev_cap = _capsule_path(raw_docs[name][-2], repo_dir)
+            last_cap = _capsule_path(raw_docs[name][-1], repo_dir)
+            if prev_cap and last_cap:
+                findings = _explain_capsules(prev_cap, last_cap)
+                if findings:
+                    capsule_explain[name] = findings
     return {
         "tool": "benchtrend",
         "band": band,
@@ -317,6 +386,7 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
                    for name, runs_ in sorted(series.items())},
         "verdicts": all_verdicts,
         "regressions": regressions,
+        "capsule_explain": capsule_explain,
         "unreadable": unreadable,
         "passed": not regressions and not unreadable,
     }
@@ -357,6 +427,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"  [{mark}] {v['metric']}: "
                       f"{v['previous']} -> {v['latest']}{change} "
                       f"({v['status']})")
+        for name, findings in sorted(
+                report.get("capsule_explain", {}).items()):
+            print(f"{name}: capsule explain (what moved)")
+            for f in findings:
+                print(f"  [{f['kind']}] {f['text']}")
         for path in report["unreadable"]:
             print(f"  [!] unreadable series file: {path}")
         print("benchtrend:", "PASS" if report["passed"] else "FAIL")
