@@ -5025,6 +5025,66 @@ def _fleetobs_kill_probe(failover: bool, dim: int = 256) -> dict:
     return out
 
 
+def _merge_throughput_probe(parties: int = 16, pairs_per_party: int = 256,
+                            dim: int = 1024, threads: int = 4,
+                            iters: int = 300, seed: int = 11) -> dict:
+    """Host-plane merge throughput, the native fast path (nogil C++
+    ``gx_merge_pairs`` behind ``merge_pairs_host``) vs the legacy
+    pure-numpy fold (``GEOMX_NATIVE_WIRE=0``), on the same pair sets:
+    ``threads`` Python threads each folding a realistic small-key round
+    (``parties`` contributions x ``pairs_per_party`` pairs into a
+    ``dim``-long dense index space) ``iters`` times.  Best-of-3 per
+    codec to shave scheduler noise; reported in Mpairs/s."""
+    import threading as _threading
+
+    import numpy as np
+
+    from geomx_tpu.compression.sparseagg import merge_pairs_host
+    from geomx_tpu.runtime import native_available
+    from geomx_tpu.service.protocol import reset_wire_codec_cache
+    rng = np.random.default_rng(seed)
+    parts = [(rng.standard_normal(pairs_per_party).astype(np.float32),
+              rng.integers(0, dim,
+                           size=pairs_per_party).astype(np.int64))
+             for _ in range(parties)]
+    total_pairs = threads * iters * parties * pairs_per_party
+
+    def run_once() -> float:
+        barrier = _threading.Barrier(threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(iters):
+                merge_pairs_host(parts)
+
+        ts = [_threading.Thread(target=worker) for _ in range(threads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return total_pairs / (time.perf_counter() - t0)
+
+    native = max(run_once() for _ in range(3))
+    old = os.environ.get("GEOMX_NATIVE_WIRE")
+    os.environ["GEOMX_NATIVE_WIRE"] = "0"
+    reset_wire_codec_cache()
+    try:
+        legacy = max(run_once() for _ in range(3))
+    finally:
+        if old is None:
+            os.environ.pop("GEOMX_NATIVE_WIRE", None)
+        else:
+            os.environ["GEOMX_NATIVE_WIRE"] = old
+        reset_wire_codec_cache()
+    return {"threads": threads, "iters": iters, "parties": parties,
+            "pairs_per_party": pairs_per_party, "dim": dim,
+            "native_mpairs_s": round(native / 1e6, 2),
+            "legacy_mpairs_s": round(legacy / 1e6, 2),
+            "speedup": round(native / legacy, 2),
+            "native_engaged": bool(native_available())}
+
+
 def _compare_fleetobs(steps: int = 10, parties: int = 16,
                       shards: int = 4, dim: int = 1024,
                       nkeys: int = 8, schedule_spec: str = None,
@@ -5039,8 +5099,11 @@ def _compare_fleetobs(steps: int = 10, parties: int = 16,
     1. every completed round yields a GAPLESS ledger record (push ->
        merge -> journal -> reply hop chain, contiguous seq);
     2. measured socket bytes reconcile with the sender-declared wire
-       bytes within the documented clean-link bound (<= 512 B framing
-       overhead per frame) on every fault-free round;
+       bytes within the documented clean-link bound (the active codec's
+       per-frame framing allowance — 192 B binary / 512 B legacy) on
+       every fault-free round, and under the binary codec the honesty
+       ratio stays <= 1.02 while the native merge fast path clears 3x
+       the legacy fold's throughput on this host;
     3. each injected fault is attributed to a named hop in a named
        round: corrupt@ -> a ``corrupt`` hop naming the shaped party,
        the in-place kill -> a session-resume ``replay`` hop naming the
@@ -5054,7 +5117,8 @@ def _compare_fleetobs(steps: int = 10, parties: int = 16,
 
     from geomx_tpu.resilience.chaos import ChaosSchedule
     from geomx_tpu.telemetry import merge_traces, rounds_in_trace
-    from geomx_tpu.telemetry.ledger import (FRAME_OVERHEAD_BOUND,
+    from geomx_tpu.telemetry.ledger import (HONESTY_BOUND,
+                                            active_frame_overhead_bound,
                                             reset_round_ledger)
     from geomx_tpu.telemetry.links import LinkObservatory
     from geomx_tpu.telemetry.registry import get_registry
@@ -5076,12 +5140,13 @@ def _compare_fleetobs(steps: int = 10, parties: int = 16,
     schedule = ChaosSchedule.from_spec(schedule_spec)
     keys, hot_shard = _fleetobs_keys(nkeys, shards)
     ledger = reset_round_ledger(capacity=max(4096, 4 * nkeys * steps))
+    frame_bound = active_frame_overhead_bound()
     rec = {"mode": "compare_fleetobs", "steps": steps,
            "parties": parties, "shards": shards, "dim": dim,
            "keys": keys, "hot_shard": hot_shard,
            "schedule": schedule.spec(), "seed": seed,
            "rebalance_at": rebalance_at,
-           "frame_overhead_bound": FRAME_OVERHEAD_BOUND}
+           "frame_overhead_bound": frame_bound}
 
     with tempfile.TemporaryDirectory(prefix="geomx_fleetobs_") as td:
         run = _manyparty_train(os.path.join(td, "chaos"), steps,
@@ -5133,7 +5198,7 @@ def _compare_fleetobs(steps: int = 10, parties: int = 16,
                if not (r["declared_rx_bytes"] > 0
                        and r["declared_rx_bytes"]
                        <= r["wire"].get("push_rx_bytes", 0)
-                       <= r["declared_rx_bytes"] + FRAME_OVERHEAD_BOUND
+                       <= r["declared_rx_bytes"] + frame_bound
                        * r["wire"].get("push_rx_frames", 0))]
     ratios = sorted(r["honesty_ratio"] for r in clean
                     if r["honesty_ratio"] is not None)
@@ -5146,6 +5211,23 @@ def _compare_fleetobs(steps: int = 10, parties: int = 16,
             round(ratios[len(ratios) // 2], 4) if ratios else None,
     }
     rec["bytes_reconciled"] = bool(clean and not bad_rec)
+
+    # declared ≈ measured under the binary codec: every clean round's
+    # honesty ratio within HONESTY_BOUND (the ≤ 1.02 acceptance the
+    # zero-copy frame exists to hit; the legacy pickled codec sat at
+    # ~1.09 — FLEETOBS_r01)
+    from geomx_tpu.service.protocol import binary_wire_enabled
+    rec["honesty_bound"] = HONESTY_BOUND
+    if binary_wire_enabled():
+        rec["honesty_ok"] = bool(ratios and ratios[-1] <= HONESTY_BOUND)
+    else:
+        rec["honesty_ok"] = True  # legacy codec: bound not claimed
+
+    # host-plane merge throughput, native fast path vs legacy fold
+    rec["merge_throughput"] = _merge_throughput_probe(
+        parties=parties, dim=dim)
+    rec["merge_speedup_ok"] = bool(
+        rec["merge_throughput"]["speedup"] >= 3.0)
 
     # ---- 3. fault -> named hop in a named round ----------------------
     def hops_of(kind):
@@ -5237,13 +5319,24 @@ def _compare_fleetobs(steps: int = 10, parties: int = 16,
         rec["round_p50_s"] = round(lats[len(lats) // 2], 4)
         rec["round_p99_s"] = round(
             lats[min(len(lats) - 1, int(0.99 * (len(lats) - 1)))], 4)
+        # the absolute percentiles are REPORTED, and gated only through
+        # this generous bounded boolean: a clean 16-process round on
+        # loopback measures host scheduling as much as the plane (the
+        # unchanged legacy codec spans ~3x run-to-run at p99 on a
+        # 4-core container), so a relative band would gate the CI
+        # host's load, not the code — same reasoning as the manyparty
+        # stall_bounded gate.  The bounds still catch a collapse.
+        rec["round_latency_bounded"] = bool(
+            rec["round_p50_s"] <= 0.5 and rec["round_p99_s"] <= 2.0)
 
     rec["ok"] = bool(
         not run["errors"] and not clean_run["errors"]
         and rec["gapless_ledger"]
-        and rec["bytes_reconciled"] and rec["faults_attributed"]
+        and rec["bytes_reconciled"] and rec["honesty_ok"]
+        and rec["merge_speedup_ok"] and rec["faults_attributed"]
         and rec["phase_histograms_ok"] and rec["trace_linked"]
-        and rec["ledger_ingested"])
+        and rec["ledger_ingested"]
+        and rec.get("round_latency_bounded", True))
 
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)

@@ -268,19 +268,60 @@ def lattice_allreduce_signs(signs, threshold: float, axis_name: str,
 # host-plane sorted-index merge (the global tier's kernel)
 # ---------------------------------------------------------------------------
 
+def _native_merge(vals: np.ndarray, idx: np.ndarray):
+    """Route the concatenated pair set through the fast-path merge when
+    the native wire path is enabled: the nogil C++ ``gx_merge_pairs``
+    if ``libgeops.so`` is built, else a numpy replica of its SEQUENTIAL
+    left-to-right float32 fold (vectorized across segments by
+    accumulation round, so it costs O(max duplicates) passes — the
+    duplicate count is the party count, small).  The replica is pinned
+    bit-identical to the C++ by tests/test_wire_fastpath.py, so which
+    one ran is unobservable in the merged bits.  Returns ``None`` under
+    ``GEOMX_NATIVE_WIRE=0`` — that switch forces the UNTOUCHED legacy
+    ``np.add.reduceat`` fold (pairwise summation, different low bits
+    than the sequential tree) exactly as shipped before the fast path
+    existed."""
+    from geomx_tpu.service.protocol import binary_wire_enabled
+    if not binary_wire_enabled():
+        return None
+    from geomx_tpu.runtime import native
+    out = native.merge_pairs(vals, idx)
+    if out is not None:
+        return out
+    keep = idx >= 0
+    vals, idx = vals[keep], idx[keep]
+    if idx.size == 0:
+        return (np.zeros((0,), np.float32), np.zeros((0,), np.int64))
+    order = np.argsort(idx, kind="stable")
+    si, sv = idx[order], vals[order]
+    head = np.ones(si.size, bool)
+    head[1:] = si[1:] != si[:-1]
+    starts = np.flatnonzero(head)
+    lens = np.diff(np.append(starts, si.size))
+    merged = sv[starts].copy()
+    for r in range(1, int(lens.max())):
+        m = lens > r
+        merged[m] = merged[m] + sv[starts[m] + r]
+    return merged, si[starts]
+
+
 def merge_pairs_host(parts) -> Tuple[np.ndarray, np.ndarray]:
     """Merge (value, index) contributions by index on the host — the
     GeoPSServer round-gate kernel (service/server.py).
 
     ``parts`` is an iterable of ``(vals, idx)`` numpy pairs in the
     caller's CANONICAL order (sorted sender id): concatenation order +
-    stable index sort + ``np.add.reduceat``'s left-to-right segment
-    fold define the summation tree completely, so the merged bits are a
-    function of the contribution set alone — never of push arrival
-    order.  Sentinel pairs (index < 0) drop.  Cost: O(K log K) in the
-    total pair count K, independent of the dense length.  Returns
-    compact ``(vals fp32, idx int64)`` sorted by index, indices
-    unique."""
+    stable index sort + a fixed per-segment fold define the summation
+    tree completely, so the merged bits are a function of the
+    contribution set alone — never of push arrival order.  Which fold:
+    the fast path (native wire enabled, default) folds each segment
+    SEQUENTIALLY left-to-right in float32 (C++ ``gx_merge_pairs`` or
+    its pinned-identical numpy replica); ``GEOMX_NATIVE_WIRE=0`` keeps
+    the original ``np.add.reduceat`` pairwise fold byte-for-byte.
+    Either way the tree is deterministic per switch setting.  Sentinel
+    pairs (index < 0) drop.  Cost: O(K log K) in the total pair count
+    K, independent of the dense length.  Returns compact ``(vals fp32,
+    idx int64)`` sorted by index, indices unique."""
     vs, is_ = [], []
     for v, i in parts:
         vs.append(np.asarray(v, np.float32).reshape(-1))
@@ -289,6 +330,9 @@ def merge_pairs_host(parts) -> Tuple[np.ndarray, np.ndarray]:
         return (np.zeros((0,), np.float32), np.zeros((0,), np.int64))
     vals = np.concatenate(vs)
     idx = np.concatenate(is_)
+    merged = _native_merge(vals, idx)
+    if merged is not None:
+        return merged
     keep = idx >= 0
     vals, idx = vals[keep], idx[keep]
     if idx.size == 0:

@@ -28,6 +28,8 @@ def _stale() -> bool:
 def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native runtime; None if unavailable."""
     global _lib
+    if _lib is not None:  # hot path: no lock once bound (GIL-atomic read)
+        return _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
@@ -123,12 +125,78 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                           i64p, i64p]
         lib.gx_recio_read_off.restype = ctypes.c_int64
         lib.gx_recio_reader_close.argtypes = [ctypes.c_void_p]
+        # wire fast path (service/protocol.py binary frames): ctypes
+        # foreign calls drop the GIL, so CRC/seal/verify and the pair
+        # merge run truly concurrently across serve/drain threads.
+        # argtypes use c_void_p for the buffers — the call sites pass
+        # writable bytearrays via (c_char * n).from_buffer and numpy
+        # arrays via .ctypes.data, which c_char_p would refuse/copy.
+        lib.gx_wire_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.gx_wire_crc32.restype = ctypes.c_uint32
+        lib.gx_wire_seal.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_int32]
+        lib.gx_wire_seal.restype = ctypes.c_int32
+        lib.gx_wire_verify.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.gx_wire_verify.restype = ctypes.c_int32
+        lib.gx_merge_pairs.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_int64, ctypes.c_void_p,
+                                       ctypes.c_void_p]
+        lib.gx_merge_pairs.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return load_native() is not None
+
+
+# ---- wire fast path (service/protocol.py binary frames) -------------------
+
+def wire_seal(frame: bytearray, version: int) -> bool:
+    """Fill a binary frame's 5-byte integrity prelude in place (version
+    byte + CRC32 of the body) with the GIL released.  Returns False
+    when the native runtime is unavailable — the caller's pure-Python
+    zlib path produces the identical bytes."""
+    lib = load_native()
+    if lib is None:
+        return False
+    # base address without minting a ctypes array TYPE per call
+    # ((c_char * n) costs ~10us of class creation; from_buffer on the
+    # scalar type is a cheap writable view that pins the bytearray)
+    base = ctypes.addressof(ctypes.c_char.from_buffer(frame))
+    return lib.gx_wire_seal(base, len(frame), int(version)) == 0
+
+
+def wire_verify(frame: bytes) -> Optional[bool]:
+    """CRC-check a sealed frame (either codec version) with the GIL
+    released.  True/False on a real check; None when the native runtime
+    is unavailable (caller falls back to zlib.crc32)."""
+    lib = load_native()
+    if lib is None:
+        return None
+    return lib.gx_wire_verify(frame, len(frame)) == 0
+
+
+def merge_pairs(vals, idx):
+    """Nogil sorted-sender pair merge — bit-identical to
+    compression.sparseagg.merge_pairs_host's numpy fold (stable index
+    sort + sequential float32 segment sums).  Takes the CONCATENATED
+    (vals f32, idx i64) contribution arrays; returns compact
+    ``(vals, idx)`` or None when the native runtime is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    import numpy as np
+    vals = np.ascontiguousarray(vals, np.float32).reshape(-1)
+    idx = np.ascontiguousarray(idx, np.int64).reshape(-1)
+    n = int(vals.size)
+    if n != int(idx.size):
+        raise ValueError(f"pair arrays disagree: {n} vs {idx.size}")
+    out_v = np.empty(n, np.float32)
+    out_i = np.empty(n, np.int64)
+    m = lib.gx_merge_pairs(vals.ctypes.data, idx.ctypes.data, n,
+                           out_v.ctypes.data, out_i.ctypes.data)
+    return out_v[:m].copy(), out_i[:m].copy()
 
 
 class NativePriorityQueue:
@@ -141,6 +209,14 @@ class NativePriorityQueue:
             raise RuntimeError("native runtime unavailable (no toolchain?)")
         self._lib = lib
         self._q = lib.gx_queue_create()
+        # persistent pop buffer, grown on demand: the old per-call
+        # ``create_string_buffer(64 KiB)`` + ``buf.raw[:n]`` pattern
+        # allocated AND materialized the whole buffer on every pop — a
+        # >1 MiB frame paid two large copies per message.  The buffer
+        # is guarded by a lock (pop is re-entrant across the send-loop
+        # and test threads) and ``string_at`` copies exactly n bytes.
+        self._pop_lock = threading.Lock()
+        self._pop_buf = ctypes.create_string_buffer(1 << 16)
 
     def push(self, payload: bytes, priority: int = 0) -> None:
         rc = self._lib.gx_queue_push(self._q, payload, len(payload),
@@ -151,20 +227,26 @@ class NativePriorityQueue:
     def pop(self, timeout: Optional[float] = None
             ) -> Optional[Tuple[bytes, int]]:
         """(payload, priority), or None on close/timeout."""
-        buf_len = 1 << 16
-        while True:
-            buf = ctypes.create_string_buffer(buf_len)
-            prio = ctypes.c_int64()
-            req = ctypes.c_int64()
-            t = -1 if timeout is None else int(timeout * 1000)
-            n = self._lib.gx_queue_pop(self._q, buf, buf_len, t,
-                                       ctypes.byref(prio), ctypes.byref(req))
-            if n == -3:
-                buf_len = int(req.value)
-                continue
-            if n < 0:
-                return None
-            return buf.raw[:n], int(prio.value)
+        with self._pop_lock:
+            while True:
+                buf = self._pop_buf
+                prio = ctypes.c_int64()
+                req = ctypes.c_int64()
+                t = -1 if timeout is None else int(timeout * 1000)
+                n = self._lib.gx_queue_pop(self._q, buf, len(buf), t,
+                                           ctypes.byref(prio),
+                                           ctypes.byref(req))
+                if n == -3:
+                    # buffer too small: the message stays queued and the
+                    # required size came back in *req — retry with
+                    # EXACTLY that size (no doubling loop; one grow per
+                    # high-water mark, kept for subsequent pops)
+                    self._pop_buf = ctypes.create_string_buffer(
+                        int(req.value))
+                    continue
+                if n < 0:
+                    return None
+                return ctypes.string_at(buf, n), int(prio.value)
 
     def close(self) -> None:
         if self._q is not None:

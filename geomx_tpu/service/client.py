@@ -33,9 +33,13 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu.service.protocol import (Msg, MsgType, _log_msg,
-                                        _verbose_level, connect_retry,
-                                        env_int, maybe_corrupt_frame,
+from geomx_tpu.service.protocol import (BATCH_DRAIN_MAX_BYTES,
+                                        BATCH_DRAIN_MAX_FRAMES, Msg,
+                                        MsgType, _log_msg,
+                                        _verbose_level,
+                                        batch_drain_enabled,
+                                        connect_retry, env_int,
+                                        maybe_corrupt_frame,
                                         recv_frame, send_frame,
                                         wire_stats)
 from geomx_tpu.service.retry import SeededBackoff, count_retry
@@ -317,12 +321,31 @@ class GeoPSClient:
                 return
             self._send_gate.wait()
             frame = item[0] if self._native_q else item
+            frames = [frame]
+            if batch_drain_enabled():
+                # small-key round batching: after the blocking pop
+                # returned a head frame, drain whatever else is already
+                # queued (timeout=0, never waiting) and ship the whole
+                # batch in ONE sendall — many small-key pushes cost one
+                # syscall instead of one each.  Each frame keeps its own
+                # length prefix, so the receiver is oblivious; per-frame
+                # ledger accounting happened at encode() time.
+                total = len(frame) + 4
+                while (len(frames) < BATCH_DRAIN_MAX_FRAMES
+                       and total < BATCH_DRAIN_MAX_BYTES):
+                    extra = self._sendq.pop(timeout=0)
+                    if extra is None:
+                        break
+                    ef = extra[0] if self._native_q else extra
+                    frames.append(ef)
+                    total += len(ef) + 4
+            blob = b"".join(len(f).to_bytes(4, "little") + f
+                            for f in frames)
             while True:
                 with self._wlock:
                     sock = self._sock
                     try:
-                        sock.sendall(
-                            len(frame).to_bytes(4, "little") + frame)
+                        sock.sendall(blob)
                         sent = True
                     except OSError:
                         sent = False
@@ -333,7 +356,7 @@ class GeoPSClient:
                 # session resume: the recv loop owns re-dialing; make
                 # sure it notices the breakage (it may be parked in a
                 # recv on the same dead socket), then park here until
-                # the connection is re-established and retry THIS frame
+                # the connection is re-established and retry THIS batch
                 # on the fresh socket — the server dedups replays
                 try:
                     sock.close()
@@ -348,7 +371,10 @@ class GeoPSClient:
                     # is still set from before the breakage): don't hot-
                     # spin close/send on the same dead socket
                     time.sleep(0.01)
-            wire_stats.add_sent(len(frame) + 4)
+            if len(frames) == 1:
+                wire_stats.add_sent(len(blob))
+            else:
+                wire_stats.add_sent_batch(len(frames), len(blob))
 
     def _recv_loop(self):
         while not self._closed:

@@ -32,8 +32,11 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomx_tpu.service.protocol import (Msg, MsgType, _log_msg,
-                                        _verbose_level, env_int,
+from geomx_tpu.service.protocol import (BATCH_DRAIN_MAX_BYTES,
+                                        BATCH_DRAIN_MAX_FRAMES, Msg,
+                                        MsgType, _log_msg,
+                                        _verbose_level,
+                                        batch_drain_enabled, env_int,
                                         recv_frame, send_frame,
                                         should_drop, wire_stats)
 from geomx_tpu.utils.heartbeat import HeartbeatMonitor
@@ -2871,14 +2874,34 @@ class GeoPSServer:
                         if frame is None:
                             return
                         gate.wait()
+                        frames = [frame]
+                        if batch_drain_enabled():
+                            # small-key round batching (mirrors the
+                            # client _send_loop): coalesce everything
+                            # already queued into one sendall; frames
+                            # keep their length prefixes, the peer's
+                            # recv loop is oblivious
+                            total = len(frame) + 4
+                            while (len(frames) < BATCH_DRAIN_MAX_FRAMES
+                                   and total < BATCH_DRAIN_MAX_BYTES):
+                                extra = q.pop(timeout=0)
+                                if extra is None:
+                                    break
+                                frames.append(extra)
+                                total += len(extra) + 4
+                        blob = b"".join(
+                            len(f).to_bytes(4, "little") + f
+                            for f in frames)
                         lock = self._conn_wlocks.setdefault(
                             qid, threading.Lock())
                         with lock:
                             try:
-                                conn.sendall(
-                                    len(frame).to_bytes(4, "little")
-                                    + frame)
-                                wire_stats.add_sent(len(frame) + 4)
+                                conn.sendall(blob)
+                                if len(frames) == 1:
+                                    wire_stats.add_sent(len(blob))
+                                else:
+                                    wire_stats.add_sent_batch(
+                                        len(frames), len(blob))
                             except OSError:
                                 # dead socket: drop our queue entry (only
                                 # if still ours — the serve thread may

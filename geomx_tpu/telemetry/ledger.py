@@ -80,10 +80,34 @@ PHASES = ("queue", "gate_wait", "merge", "journal", "reply")
 # choke point
 _WIRE_KINDS = {"PUSH": "push", "PULL_REPLY": "reply", "RELAY": "relay"}
 
-# documented clean-link framing bound: one frame's overhead over its
-# declared payload (version+CRC prelude, length words, pickled header)
-# never exceeds this — the reconciliation gate's per-frame allowance
+# documented clean-link framing bounds: one frame's overhead over its
+# declared payload never exceeds these — the reconciliation gate's
+# per-frame allowance.  512 B is the LEGACY pickled codec's bound
+# (version+CRC prelude, length words, pickled header); the binary v0x02
+# codec's exact header-size bound is much tighter (192 B, derived
+# field-by-field in service/protocol.py as BIN_FRAME_OVERHEAD_BOUND)
+# and :func:`active_frame_overhead_bound` resolves whichever codec is
+# encoding.
 FRAME_OVERHEAD_BOUND = 512
+
+# clean-round honesty assertion under the binary codec: measured push
+# bytes over declared payload bytes must stay within 2% — only asserted
+# when the average frame payload clears the floor below (tiny control
+# payloads are legitimately header-dominated and say nothing about wire
+# honesty)
+HONESTY_BOUND = 1.02
+HONESTY_MIN_FRAME_PAYLOAD = 4096
+
+
+def active_frame_overhead_bound() -> int:
+    """The per-frame framing allowance for whichever codec
+    ``Msg.encode`` is currently producing: the exact binary-frame
+    header bound under the default v0x02 codec, the legacy 512 B
+    pickled-header allowance under ``GEOMX_NATIVE_WIRE=0``."""
+    from geomx_tpu.service.protocol import (BIN_FRAME_OVERHEAD_BOUND,
+                                            binary_wire_enabled)
+    return BIN_FRAME_OVERHEAD_BOUND if binary_wire_enabled() \
+        else FRAME_OVERHEAD_BOUND
 
 
 def _ledger_capacity() -> int:
@@ -133,13 +157,21 @@ class RoundRecord:
             return self.wire.get("push_tx_bytes", 0) / self.declared_tx
         return None
 
-    def reconciles(self,
-                   per_frame_bound: int = FRAME_OVERHEAD_BOUND) -> bool:
+    def reconciles(self, per_frame_bound: Optional[int] = None,
+                   honesty_bound: Optional[float] = None) -> bool:
         """The byte-true reconciliation gate for a CLEAN round (callers
         filter on :meth:`fault_hops`): measured push bytes cover the
         declared payload exactly once plus at most ``per_frame_bound``
         framing overhead per frame (docs/telemetry.md states the
-        bound)."""
+        bounds; ``None`` resolves the active codec's bound via
+        :func:`active_frame_overhead_bound`).  Under the binary codec
+        the gate additionally ASSERTS declared ≈ measured — honesty
+        ratio ≤ ``honesty_bound`` (default :data:`HONESTY_BOUND`) —
+        whenever the average frame payload clears
+        :data:`HONESTY_MIN_FRAME_PAYLOAD`; pass an explicit
+        ``honesty_bound`` to force or loosen that check."""
+        if per_frame_bound is None:
+            per_frame_bound = active_frame_overhead_bound()
         if self.declared_rx > 0:
             measured = self.wire.get("push_rx_bytes", 0)
             frames = self.wire.get("push_rx_frames", 0)
@@ -150,7 +182,17 @@ class RoundRecord:
             declared = self.declared_tx
         else:
             return False
-        return declared <= measured <= declared + per_frame_bound * frames
+        if not (declared <= measured
+                <= declared + per_frame_bound * frames):
+            return False
+        if honesty_bound is None:
+            from geomx_tpu.service.protocol import binary_wire_enabled
+            if not binary_wire_enabled():
+                return True
+            honesty_bound = HONESTY_BOUND
+        if frames > 0 and declared >= HONESTY_MIN_FRAME_PAYLOAD * frames:
+            return measured <= honesty_bound * declared
+        return True
 
     def snapshot(self) -> dict:
         return {

@@ -348,13 +348,25 @@ void gx_sgd_mom_update(float* w, const float* g, float* mom, int64_t n,
 static const uint32_t kGxRecMagic = 0xCED7230Au;
 
 struct GxCrcTable {
-  uint32_t t[256];
+  // slice-by-8: t[0] is the classic byte-at-a-time table; t[k][b] is
+  // the CRC of byte b followed by k zero bytes, letting the hot loop
+  // fold 8 input bytes per iteration (one 64-bit load + 8 table
+  // lookups) instead of one.  Pure table math over the same reflected
+  // polynomial — results are identical to zlib.crc32 for every input.
+  uint32_t t[8][256];
   GxCrcTable() {
     for (uint32_t i = 0; i < 256; ++i) {
       uint32_t c = i;
       for (int j = 0; j < 8; ++j)
         c = (c & 1) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
-      t[i] = c;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = t[0][i];
+      for (int k = 1; k < 8; ++k) {
+        c = t[0][c & 0xFFu] ^ (c >> 8);
+        t[k][i] = c;
+      }
     }
   }
 };
@@ -364,8 +376,23 @@ static uint32_t gx_crc32(const uint8_t* data, int64_t len) {
   // magic-static: the table build is thread-safe on first concurrent use
   static const GxCrcTable table;
   uint32_t c = 0xFFFFFFFFu;
-  for (int64_t i = 0; i < len; ++i)
-    c = table.t[(c ^ data[i]) & 0xFFu] ^ (c >> 8);
+  int64_t i = 0;
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+  // the 8-byte folding step reads the stream as two LE u32 words; on a
+  // big-endian host the byte-at-a-time tail below handles everything
+  for (; i + 8 <= len; i += 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, data + i, 4);
+    std::memcpy(&hi, data + i + 4, 4);
+    lo ^= c;
+    c = table.t[7][lo & 0xFFu] ^ table.t[6][(lo >> 8) & 0xFFu] ^
+        table.t[5][(lo >> 16) & 0xFFu] ^ table.t[4][(lo >> 24) & 0xFFu] ^
+        table.t[3][hi & 0xFFu] ^ table.t[2][(hi >> 8) & 0xFFu] ^
+        table.t[1][(hi >> 16) & 0xFFu] ^ table.t[0][(hi >> 24) & 0xFFu];
+  }
+#endif
+  for (; i < len; ++i)
+    c = table.t[0][(c ^ data[i]) & 0xFFu] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
 
@@ -535,6 +562,126 @@ void gx_recio_reader_close(void* h) {
   auto* r = static_cast<GxRecReader*>(h);
   if (r->f) fclose(r->f);
   delete r;
+}
+
+// ---------------------------------------------------------------------------
+// Host wire fast path (service/protocol.py binary frames).
+//
+// The two O(payload) loops of the host plane's frame machinery — CRC32
+// over the frame body at encode/decode, plus the one payload pass the
+// sealed frame assembly implies — live here so the Python layer's
+// ctypes calls run them with the GIL RELEASED: a multi-threaded
+// host-plane process (per-connection serve threads, the P3 drain
+// threads, the relay dispatcher) stops serializing its frame work on
+// the interpreter lock.  The frame layout is owned by
+// service/protocol.py (v0x02): [u8 version][u32 crc32(body)][body];
+// these helpers only fill/check the 5-byte integrity prelude, so the
+// Python fallback (zlib.crc32 + struct) is bit-identical by
+// construction — gx_crc32 is the standard reflected CRC-32, the same
+// polynomial and reflection zlib uses.
+// ---------------------------------------------------------------------------
+
+uint32_t gx_wire_crc32(const uint8_t* data, int64_t len) {
+  return gx_crc32(data, len);
+}
+
+// Seal a frame in place: writes the version byte and the little-endian
+// CRC32 of frame[5..len) into the 5-byte prelude the caller left blank.
+// Returns 0, or -1 if the frame cannot even hold a prelude.
+int32_t gx_wire_seal(uint8_t* frame, int64_t len, int32_t version) {
+  if (len < 5) return -1;
+  frame[0] = static_cast<uint8_t>(version);
+  uint32_t crc = gx_crc32(frame + 5, len - 5);
+  frame[1] = static_cast<uint8_t>(crc & 0xFFu);
+  frame[2] = static_cast<uint8_t>((crc >> 8) & 0xFFu);
+  frame[3] = static_cast<uint8_t>((crc >> 16) & 0xFFu);
+  frame[4] = static_cast<uint8_t>((crc >> 24) & 0xFFu);
+  return 0;
+}
+
+// Verify a sealed frame's prelude CRC (either codec version — the CRC
+// discipline is identical).  Returns 0 on match, -1 if truncated below
+// the prelude, -2 on mismatch.
+int32_t gx_wire_verify(const uint8_t* frame, int64_t len) {
+  if (len < 5) return -1;
+  uint32_t want = static_cast<uint32_t>(frame[1]) |
+                  (static_cast<uint32_t>(frame[2]) << 8) |
+                  (static_cast<uint32_t>(frame[3]) << 16) |
+                  (static_cast<uint32_t>(frame[4]) << 24);
+  return gx_crc32(frame + 5, len - 5) == want ? 0 : -2;
+}
+
+// Sorted-sender pair merge (compression/sparseagg.merge_pairs_host):
+// concatenated (value, index) contributions -> compact unique-index
+// sums.  The summation tree is pinned: drop sentinels (idx < 0), and
+// fold each index's values SEQUENTIALLY left-to-right in float32, in
+// concatenation (sorted-sender) order — the same tree as the Python
+// replica in sparseagg._native_merge (stable argsort + sequential
+// segment fold), bit-identical by construction.
+//
+// Two algorithms compute that identical fold:
+//  - dense accumulation, O(n + range), when the index range is within
+//    a constant factor of the pair count (the common small-key case:
+//    indices are positions in a dense gradient): one forward scan does
+//    acc[idx] += val, which meets each index's values in concatenation
+//    order — the sequential fold without any sort;
+//  - stable sort + run fold, O(n log n), for sparse far-flung indices
+//    where a dense scratch would not fit.
+// out_vals/out_idx must hold n entries; returns the number of unique
+// output pairs written (<= n), ascending by index.
+int64_t gx_merge_pairs(const float* vals, const int64_t* idx, int64_t n,
+                       float* out_vals, int64_t* out_idx) {
+  int64_t maxi = -1, live = 0;
+  for (int64_t i = 0; i < n; ++i)
+    if (idx[i] >= 0) {
+      ++live;
+      if (idx[i] > maxi) maxi = idx[i];
+    }
+  if (live == 0) return 0;
+  const int64_t range = maxi + 1;
+  if (range <= 8 * n + 1024) {
+    std::vector<float> acc(static_cast<size_t>(range));
+    std::vector<uint8_t> seen(static_cast<size_t>(range), 0);
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t ix = idx[i];
+      if (ix < 0) continue;
+      if (seen[ix]) {
+        acc[ix] += vals[i];
+      } else {
+        seen[ix] = 1;
+        acc[ix] = vals[i];
+      }
+    }
+    int64_t m = 0;
+    for (int64_t ix = 0; ix < range; ++ix)
+      if (seen[ix]) {
+        out_vals[m] = acc[ix];
+        out_idx[m] = ix;
+        ++m;
+      }
+    return m;
+  }
+  std::vector<int64_t> pos;
+  pos.reserve(static_cast<size_t>(live));
+  for (int64_t i = 0; i < n; ++i)
+    if (idx[i] >= 0) pos.push_back(i);
+  std::stable_sort(pos.begin(), pos.end(),
+                   [&](int64_t a, int64_t b) { return idx[a] < idx[b]; });
+  int64_t m = 0;
+  size_t k = 0;
+  while (k < pos.size()) {
+    int64_t cur = idx[pos[k]];
+    float acc = vals[pos[k]];  // float accumulator: the pinned fold
+    ++k;
+    while (k < pos.size() && idx[pos[k]] == cur) {
+      acc += vals[pos[k]];
+      ++k;
+    }
+    out_vals[m] = acc;
+    out_idx[m] = cur;
+    ++m;
+  }
+  return m;
 }
 
 }  // extern "C"

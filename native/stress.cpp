@@ -30,6 +30,11 @@ void gx_ts_destroy(void* p);
 void gx_ts_report(void* p, int s, int r, double thr, int64_t version);
 int gx_ts_ask(void* p, int sender, int64_t version);
 int gx_ts_ask1_key(void* p, int node, const char* key, int num, int* out);
+
+int32_t gx_wire_seal(uint8_t* frame, int64_t len, int32_t version);
+int32_t gx_wire_verify(const uint8_t* frame, int64_t len);
+int64_t gx_merge_pairs(const float* vals, const int64_t* idx, int64_t n,
+                       float* out_vals, int64_t* out_idx);
 }
 
 int main() {
@@ -82,6 +87,50 @@ int main() {
   }
   for (auto& t : threads) t.join();
   gx_ts_destroy(ts);
+
+  // --- wire fast path: concurrent seal/verify + pair merges ---
+  // (the hot host-plane loops PR 16 moved native: every serve/drain
+  // thread seals and verifies frames concurrently while merges run;
+  // the magic-static CRC table's first-use build is the TSAN-relevant
+  // edge, so every thread starts cold)
+  threads.clear();
+  bool wire_ok = true;
+  for (int w = 0; w < 8; ++w) {
+    threads.emplace_back([w, &wire_ok] {
+      std::vector<uint8_t> frame(5 + 4096);
+      for (size_t i = 5; i < frame.size(); ++i)
+        frame[i] = static_cast<uint8_t>((i * (w + 3)) & 0xFF);
+      std::vector<float> vals(4096);
+      std::vector<int64_t> idx(4096);
+      std::vector<float> ov(4096);
+      std::vector<int64_t> oi(4096);
+      for (int it = 0; it < 500; ++it) {
+        frame[5] = static_cast<uint8_t>(it & 0xFF);
+        if (gx_wire_seal(frame.data(),
+                         static_cast<int64_t>(frame.size()), 2) != 0 ||
+            gx_wire_verify(frame.data(),
+                           static_cast<int64_t>(frame.size())) != 0) {
+          wire_ok = false;
+          return;
+        }
+        for (int i = 0; i < 4096; ++i) {
+          vals[i] = static_cast<float>((i * 7 + it) % 13) * 0.5f;
+          idx[i] = (i % 11 == 0) ? -1 : (i * (w + 1)) % 257;
+        }
+        int64_t m = gx_merge_pairs(vals.data(), idx.data(), 4096,
+                                   ov.data(), oi.data());
+        if (m <= 0 || m > 4096) {
+          wire_ok = false;
+          return;
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  if (!wire_ok) {
+    std::printf("stress: wire FAIL\n");
+    return 1;
+  }
 
   std::printf("stress: OK\n");
   return 0;

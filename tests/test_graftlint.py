@@ -222,6 +222,33 @@ def test_waiver_suppresses_on_line_and_line_above():
     assert _lint_source(disable_all) == []
 
 
+def test_pickle_on_service_path_fires_gx_wire_001():
+    src = ("import pickle\n"
+           "def encode(h):\n"
+           "    return pickle.dumps(h)\n"
+           "def decode(b):\n"
+           "    return pickle.loads(b)\n"
+           "class U(pickle.Unpickler):\n"
+           "    pass\n")
+    hits = _rules(_lint_source(src, path="geomx_tpu/service/fake.py"))
+    assert hits == ["GX-WIRE-001"] * 3
+    # the `from pickle import loads` spelling resolves through aliases
+    aliased = ("from pickle import loads as _l\n"
+               "def decode(b):\n"
+               "    return _l(b)\n")
+    assert _rules(_lint_source(
+        aliased, path="geomx_tpu/service/fake.py")) == ["GX-WIRE-001"]
+    # same source outside geomx_tpu/service/ is not the wire hot path
+    assert _lint_source(src, path="geomx_tpu/utils/fake.py") == []
+    assert _lint_source(src, path="tools/fake.py", in_package=False) == []
+    # the hyphenated rule id waives with the documented syntax
+    waiver = "# graftlint: " + "dis" + "able=GX-WIRE-001 — legacy codec"
+    waived = ("import pickle\n"
+              "def encode(h):\n"
+              f"    return pickle.dumps(h)  {waiver}\n")
+    assert _lint_source(waived, path="geomx_tpu/service/fake.py") == []
+
+
 def test_repo_lints_clean_against_committed_baseline():
     findings, waivers = gl.lint_paths(gl.DEFAULT_ROOTS)
     assert findings == [], [f.format() for f in findings]
@@ -253,7 +280,8 @@ def test_cli_json_and_baseline_gate(tmp_path, capsys, monkeypatch):
 
 
 @pytest.mark.parametrize("rule", ["GXL001", "GXL002", "GXL003",
-                                  "GXL004", "GXL005", "GXL006"])
+                                  "GXL004", "GXL005", "GXL006",
+                                  "GX-WIRE-001"])
 def test_rule_catalog_documented(rule):
     """Every rule id the linter can emit is documented in its module
     docstring AND in docs/analysis.md."""

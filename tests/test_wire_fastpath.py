@@ -1,0 +1,448 @@
+"""The native host-plane fast path: v0x02 binary wire frames, the
+compact P3-chunk header, nogil CRC/merge, and round batching.
+
+Three properties anchor everything here:
+
+1. BIT-IDENTITY — ``GEOMX_NATIVE_WIRE=0`` produces byte-for-byte the
+   legacy pickled v0x01 frames (pinned against a hand-built frame), and
+   the native CRC seal is bit-identical to the zlib fallback.
+2. MIXED FLEET — decode always accepts BOTH codec versions regardless
+   of the env knob: a binary sender and a legacy receiver (or vice
+   versa) interoperate per frame via the version byte.
+3. INTEGRITY — truncation and bit flips anywhere in the CRC-covered
+   region surface as :class:`FrameIntegrityError`, never as a
+   mis-parsed message.
+"""
+
+import random
+import string
+import zlib
+
+import numpy as np
+import pytest
+
+from geomx_tpu.service.protocol import (FRAME_VERSION, FRAME_VERSION_BIN,
+                                        FrameIntegrityError, Msg, MsgType,
+                                        reset_wire_codec_cache, wire_stats)
+
+# ---------------------------------------------------------------------------
+# codec env plumbing
+
+
+@pytest.fixture
+def codec_env(monkeypatch):
+    """Set wire-codec env knobs and keep the process-wide codec cache
+    coherent: reset after every change AND after the monkeypatch undo
+    (in that order), so no cached value leaks across tests."""
+    def set_(**kv):
+        for k, v in kv.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, str(v))
+        reset_wire_codec_cache()
+    yield set_
+    monkeypatch.undo()
+    reset_wire_codec_cache()
+
+
+def _rand_meta(rng: random.Random, depth: int = 0):
+    kinds = ["int", "str", "bool", "none", "float", "bytes", "big"]
+    if depth < 2:
+        kinds += ["list", "dict", "tuple"]
+    k = rng.choice(kinds)
+    if k == "int":
+        return rng.randint(-(1 << 40), 1 << 40)
+    if k == "str":
+        return "".join(rng.choice(string.printable + "é中\U0001f600")
+                       for _ in range(rng.randint(0, 12)))
+    if k == "bool":
+        return rng.random() < 0.5
+    if k == "none":
+        return None
+    if k == "float":
+        return rng.uniform(-1e9, 1e9)
+    if k == "bytes":
+        return rng.randbytes(rng.randint(0, 8))
+    if k == "big":
+        return rng.randint(1 << 70, 1 << 80) * rng.choice((-1, 1))
+    if k == "list":
+        return [_rand_meta(rng, depth + 1) for _ in range(rng.randint(0, 3))]
+    if k == "tuple":
+        return tuple(_rand_meta(rng, depth + 1)
+                     for _ in range(rng.randint(0, 3)))
+    return {("k%d" % i if rng.random() < 0.5 else _rand_str(rng)):
+            _rand_meta(rng, depth + 1) for i in range(rng.randint(0, 3))}
+
+
+def _rand_str(rng: random.Random) -> str:
+    return "".join(rng.choice("abcé中\U0001f600xyz_")
+                   for _ in range(rng.randint(1, 10)))
+
+
+def _rand_msg(rng: random.Random) -> Msg:
+    arr = None
+    if rng.random() < 0.7:
+        dt = rng.choice(["<f4", "<f2", "<f8", "<i8", "<i4", "|u1", "<u4",
+                         ">f4", "<u2"])
+        # no 0-d shapes: encode's ascontiguousarray promotes them to
+        # (1,) on BOTH codecs, so they are not round-trip stable
+        shape = rng.choice([(0,), (1,), (17,), (3, 5), (2, 3, 4),
+                            (65537,)])
+        arr = ((np.arange(int(np.prod(shape))) % 97)
+               .astype(np.dtype(dt))
+               .reshape(shape))
+    meta = {_rand_str(rng): _rand_meta(rng)
+            for _ in range(rng.randint(0, 4))}
+    return Msg(type=rng.choice(list(MsgType)),
+               key=rng.choice(["w", "w13", _rand_str(rng), "中文-ключ"]),
+               sender=rng.choice([-1, 0, 13, 2**31 - 1, -2**31]),
+               meta=meta, array=arr)
+
+
+def _assert_same(a: Msg, b: Msg):
+    assert a.type == b.type and a.key == b.key and a.sender == b.sender
+    assert a.meta == b.meta
+    if a.array is None:
+        assert b.array is None
+    else:
+        assert b.array.dtype == a.array.dtype
+        assert b.array.shape == tuple(np.shape(a.array))
+        assert np.array_equal(np.nan_to_num(np.asarray(b.array, dtype="f8")),
+                              np.nan_to_num(np.asarray(a.array, dtype="f8")))
+
+
+# ---------------------------------------------------------------------------
+# 1. fuzz round-trips, both codecs
+
+
+@pytest.mark.parametrize("native_wire", ["1", "0"])
+def test_fuzz_roundtrip(codec_env, native_wire):
+    codec_env(GEOMX_NATIVE_WIRE=native_wire)
+    rng = random.Random(0xF057 + int(native_wire))
+    for _ in range(120):
+        m = _rand_msg(rng)
+        f = m.encode()
+        assert f[0] == (FRAME_VERSION_BIN if native_wire == "1"
+                        else FRAME_VERSION)
+        _assert_same(m, Msg.decode(f))
+
+
+def test_roundtrip_edge_payloads(codec_env):
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    cases = [
+        np.frombuffer(b"", dtype=np.float32),          # empty payload
+        np.zeros((0, 7), np.float16),                  # empty multi-dim
+        np.arange(1 << 20, dtype=np.uint8),            # 1 MiB payload
+        np.float64(3.5).reshape(()),                   # 0-d -> (1,) on wire
+    ]
+    for arr in cases:
+        m = Msg(type=MsgType.PUSH, key="éκλειδί", sender=7,
+                meta={"round": 1}, array=arr)
+        d = Msg.decode(m.encode())
+        wire = np.ascontiguousarray(arr)  # what encode actually ships
+        assert d.array.dtype == wire.dtype and d.array.shape == wire.shape
+        assert d.array.tobytes() == wire.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# 2. mixed-fleet interop: decode accepts both versions regardless of env
+
+
+def test_mixed_fleet_version_negotiation(codec_env):
+    m = Msg(type=MsgType.PUSH, key="w", sender=1,
+            meta={"round": 2, "rid": 5}, array=np.ones(16, np.float32))
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    f_bin = m.encode()
+    codec_env(GEOMX_NATIVE_WIRE="0")
+    f_leg = m.encode()
+    assert f_bin[0] == FRAME_VERSION_BIN and f_leg[0] == FRAME_VERSION
+    # legacy-configured receiver still decodes a binary frame...
+    _assert_same(m, Msg.decode(f_bin))
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    # ...and a binary-configured receiver still decodes a legacy frame
+    _assert_same(m, Msg.decode(f_leg))
+
+
+def test_legacy_codec_byte_pin(codec_env):
+    """NATIVE_WIRE=0 is byte-for-byte the prior wire format: pin it
+    against a hand-built pickled v0x01 frame."""
+    import pickle
+    import struct
+    codec_env(GEOMX_NATIVE_WIRE="0")
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    m = Msg(type=MsgType.PUSH, key="w3", sender=3,
+            meta={"round": 9, "rid": 42, "resend": True}, array=arr)
+    header = {"t": int(MsgType.PUSH), "k": "w3", "s": 3,
+              "m": {"round": 9, "rid": 42, "resend": True},
+              "dtype": "<f4", "shape": (4, 6)}
+    hb = pickle.dumps(header, protocol=4)  # graftlint: disable=GX-WIRE-001 — building the legacy pin fixture
+    body = struct.pack("<I", len(hb)) + hb + arr.tobytes()
+    expect = bytes((FRAME_VERSION,)) + struct.pack(
+        "<I", zlib.crc32(body)) + body
+    assert m.encode() == expect
+
+
+# ---------------------------------------------------------------------------
+# 3. integrity: truncation and bit flips
+
+
+def test_truncated_frames_raise(codec_env):
+    for nw in ("1", "0"):
+        codec_env(GEOMX_NATIVE_WIRE=nw)
+        f = Msg(type=MsgType.PUSH, key="w", sender=0,
+                meta={"round": 1}, array=np.ones(64, np.float32)).encode()
+        for cut in [0, 1, 4, 5, 8, 9, len(f) // 2, len(f) - 1]:
+            with pytest.raises(FrameIntegrityError):
+                Msg.decode(f[:cut])
+
+
+def test_bit_flips_raise(codec_env):
+    rng = random.Random(0xB17)
+    for nw in ("1", "0"):
+        codec_env(GEOMX_NATIVE_WIRE=nw)
+        f = Msg(type=MsgType.PUSH, key="w", sender=0,
+                meta={"round": 1, "rid": 7},
+                array=np.ones(64, np.float32)).encode()
+        positions = {1, 5, 9, len(f) - 1} | {
+            rng.randrange(len(f)) for _ in range(24)}
+        for pos in positions:
+            bad = bytearray(f)
+            bad[pos] ^= 1 << rng.randrange(8)
+            with pytest.raises(FrameIntegrityError):
+                Msg.decode(bytes(bad))
+
+
+def test_unknown_version_raises(codec_env):
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    f = bytearray(Msg(type=MsgType.ACK, key="", sender=0, meta={}).encode())
+    f[0] = 0x7F
+    with pytest.raises(FrameIntegrityError):
+        Msg.decode(bytes(f))
+
+
+# ---------------------------------------------------------------------------
+# 4. native seal/verify bit-identity with the zlib fallback
+
+
+def test_native_seal_matches_zlib(codec_env):
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    from geomx_tpu.runtime import native
+    for n in (0, 1, 64, 4096, 1 << 20):
+        m = Msg(type=MsgType.PUSH, key="w", sender=2,
+                meta={"round": 1}, array=np.arange(n, dtype=np.uint8))
+        f = m.encode()
+        # whatever sealed it, the CRC must be exactly zlib's over frame[5:]
+        assert int.from_bytes(f[1:5], "little") == zlib.crc32(f[5:])
+        if native.native_available():
+            assert native.wire_verify(f) is True
+            fb = bytearray(f)
+            fb[0] = 0
+            fb[1:5] = b"\0\0\0\0"
+            assert native.wire_seal(fb, FRAME_VERSION_BIN)
+            assert bytes(fb) == f
+
+
+# ---------------------------------------------------------------------------
+# 5. compact P3-chunk header: wire honesty at 2048 B chunks
+
+
+def _chunk_meta(**over):
+    m = {"chunk": 1, "num_chunks": 2, "start": 512, "n_total": 1024,
+         "shape": [1024], "round": 7, "wire_declared": 2048,
+         "rid": 1316009598}
+    m.update(over)
+    return m
+
+
+def test_compact_chunk_overhead_and_roundtrip(codec_env):
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    arr = np.arange(512, dtype=np.float32)
+    for over in ({}, {"resend": True}, {"reliable": True},
+                 {"resend": True, "reliable": True}):
+        meta = _chunk_meta(**over)
+        m = Msg(type=MsgType.PUSH, key="w13", sender=13, meta=meta,
+                array=arr)
+        f = m.encode()
+        overhead = len(f) + 4 - arr.nbytes  # +4: socket length prefix
+        # the wire-honesty budget: <= 1.02x declared at 2048 B chunks
+        assert overhead <= 40, (over, overhead)
+        assert (arr.nbytes + overhead) / meta["wire_declared"] <= 1.02
+        _assert_same(m, Msg.decode(f))
+
+
+def test_compact_fallback_is_transparent(codec_env):
+    """Every out-of-range field falls back to the generic TLV form and
+    still round-trips exactly."""
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    arr = np.arange(512, dtype=np.float32)
+    variants = [
+        _chunk_meta(chunk=300),                 # > u8
+        _chunk_meta(start=-1),                  # negative
+        _chunk_meta(rid=1 << 40),               # > u32
+        _chunk_meta(resend=False),              # non-True marker
+        _chunk_meta(reliable=1),                # non-True marker
+        _chunk_meta(shape=[512, 2]),            # shape != [n_total]
+        _chunk_meta(extra="x"),                 # unknown key
+        dict(_chunk_meta(), **{"round": True}), # bool where int expected
+    ]
+    for meta in variants:
+        m = Msg(type=MsgType.PUSH, key="w1", sender=1, meta=meta, array=arr)
+        _assert_same(m, Msg.decode(m.encode()))
+    # non-1-D and non-table dtypes also fall back
+    for a in (arr.reshape(2, 256), arr.astype(">f4"), None):
+        m = Msg(type=MsgType.PUSH, key="w1", sender=1,
+                meta=_chunk_meta(), array=a)
+        _assert_same(m, Msg.decode(m.encode()))
+
+
+# ---------------------------------------------------------------------------
+# 6. merge fast path: native and replica folds are bit-identical
+
+
+def test_merge_native_matches_replica(codec_env):
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    from geomx_tpu.compression import sparseagg
+    from geomx_tpu.runtime import native
+    rng = np.random.RandomState(0x6E)
+    for trial in range(40):
+        n = rng.randint(1, 3000)
+        hi = rng.choice([16, 1000, 1 << 20, 1 << 50])
+        idx = rng.randint(0, hi, size=n).astype(np.int64)
+        vals = rng.randn(n).astype(np.float32)
+        if trial % 5 == 0:
+            idx[rng.rand(n) < 0.3] = -1  # padding: dropped by the keep-filter
+        pairs = [(vals, idx)]
+        got_v, got_i = sparseagg.merge_pairs_host(pairs)
+        # reference: the pinned sequential left-to-right float32 fold
+        keep = idx >= 0
+        sv, si = vals[keep], idx[keep]
+        order = np.argsort(si, kind="stable")
+        sv, si = sv[order], si[order]
+        ref = {}
+        for v, i in zip(sv, si):
+            ref[int(i)] = np.float32(ref.get(int(i), np.float32(0)) + v) \
+                if int(i) in ref else np.float32(v)
+        ref_i = np.array(sorted(ref), dtype=np.int64)
+        ref_v = np.array([ref[i] for i in sorted(ref)], dtype=np.float32)
+        assert np.array_equal(got_i, ref_i)
+        assert got_v.tobytes() == ref_v.tobytes(), trial
+        if native.native_available():
+            nv, ni = native.merge_pairs(sv, si)
+            assert np.array_equal(ni, ref_i)
+            assert nv.tobytes() == ref_v.tobytes(), trial
+
+
+def test_merge_legacy_codec_unchanged(codec_env):
+    """NATIVE_WIRE=0 keeps the original reduceat merge byte-for-byte."""
+    codec_env(GEOMX_NATIVE_WIRE="0")
+    from geomx_tpu.compression import sparseagg
+    rng = np.random.RandomState(7)
+    vals = rng.randn(500).astype(np.float32)
+    idx = rng.randint(0, 100, 500).astype(np.int64)
+    idx[rng.rand(500) < 0.2] = -1  # padding entries
+    got_v, got_i = sparseagg.merge_pairs_host([(vals, idx)])
+    keep = idx >= 0
+    sv, si = vals[keep], idx[keep]
+    order = np.argsort(si, kind="stable")
+    sv, si = sv[order], si[order]
+    heads = np.ones(si.size, bool)
+    heads[1:] = si[1:] != si[:-1]
+    starts = np.flatnonzero(heads)
+    ref_v = np.add.reduceat(sv, starts).astype(np.float32)
+    assert np.array_equal(got_i, si[starts])
+    assert got_v.tobytes() == ref_v.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# 7. native queue: >1 MiB frame pop regression
+
+
+def test_native_queue_large_frame():
+    from geomx_tpu.runtime import native
+    if not native.native_available():
+        pytest.skip("libgeops.so not built")
+    q = native.NativePriorityQueue()
+    try:
+        big = bytes(bytearray(range(256)) * 4096 * 2)  # 2 MiB, > pop buf
+        small = b"tiny"
+        q.push(small, 1)
+        q.push(big, 9)
+        data, prio = q.pop(timeout=1.0)
+        assert prio == 9 and data == big
+        data, prio = q.pop(timeout=1.0)
+        assert prio == 1 and data == small
+        assert q.pop(timeout=0) is None  # non-blocking empty pop
+    finally:
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# 8. round batching: one queue drain -> one sendall
+
+
+def test_batch_drain_coalesces_frames(codec_env):
+    codec_env(GEOMX_NATIVE_WIRE="1", GEOMX_BATCH_DRAIN="1")
+    from geomx_tpu.service import GeoPSClient, GeoPSServer
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0,
+                    p3_slice_elems=256)
+    n = 2048
+    g = np.random.RandomState(3).randn(n).astype(np.float32)
+    c.init("w", np.zeros(n, np.float32))
+    before = wire_stats.snapshot()
+    c.pause_sending()
+    t = c.push_async("w", g, priority=0)  # 8 chunks held behind the gate
+    c.resume_sending()
+    c.wait(t)
+    assert np.array_equal(c.pull("w"), g)
+    after = wire_stats.snapshot()
+    assert after["batches_sent"] > before["batches_sent"]
+    assert after["batched_frames"] - before["batched_frames"] >= 2
+    c.stop_server()
+    c.close()
+
+
+def test_batch_drain_disabled_is_frame_at_a_time(codec_env):
+    codec_env(GEOMX_NATIVE_WIRE="1", GEOMX_BATCH_DRAIN="0")
+    from geomx_tpu.service import GeoPSClient, GeoPSServer
+    server = GeoPSServer(num_workers=1, mode="sync").start()
+    c = GeoPSClient(("127.0.0.1", server.port), sender_id=0,
+                    p3_slice_elems=256)
+    n = 2048
+    g = np.random.RandomState(4).randn(n).astype(np.float32)
+    c.init("w", np.zeros(n, np.float32))
+    before = wire_stats.snapshot()
+    c.pause_sending()
+    t = c.push_async("w", g, priority=0)
+    c.resume_sending()
+    c.wait(t)
+    assert np.array_equal(c.pull("w"), g)
+    after = wire_stats.snapshot()
+    assert after["batches_sent"] == before["batches_sent"]
+    c.stop_server()
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# 9. ledger honesty gate under the binary codec
+
+
+def test_ledger_honesty_asserted_under_binary(codec_env):
+    from geomx_tpu.telemetry.ledger import (HONESTY_BOUND,
+                                            HONESTY_MIN_FRAME_PAYLOAD,
+                                            RoundRecord,
+                                            active_frame_overhead_bound)
+    codec_env(GEOMX_NATIVE_WIRE="1")
+    assert active_frame_overhead_bound() == 192
+    rr = RoundRecord("w", 1)
+    rr.declared_rx = 4 * HONESTY_MIN_FRAME_PAYLOAD
+    rr.wire["push_rx_frames"] = 4
+    rr.wire["push_rx_bytes"] = int(rr.declared_rx * 1.01)
+    assert rr.reconciles()
+    rr.wire["push_rx_bytes"] = int(rr.declared_rx * (HONESTY_BOUND + 0.02))
+    assert not rr.reconciles()
+    # legacy codec: same record, honesty not asserted, 512 B bound
+    codec_env(GEOMX_NATIVE_WIRE="0")
+    assert active_frame_overhead_bound() == 512
+    assert rr.reconciles()

@@ -75,8 +75,8 @@ DIRECTION = {
     "vs_baseline": "up",
     "merge_throughput_scaling": "up",
     "sparse_vs_dense": "up",
-    "round_p99_s": "down",
-    "round_p50_s": "down",
+    "honesty_ratio_max": "down",
+    "merge_speedup": "up",
     "cost_model_max_rel_err": "down",
 }
 
@@ -150,23 +150,39 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
         return out
     if rec.get("mode") == "compare_fleetobs":  # FLEETOBS_r*
         for gate in ("ok", "gapless_ledger", "zero_lost_rounds",
-                     "bytes_reconciled", "faults_attributed",
+                     "bytes_reconciled", "honesty_ok",
+                     "merge_speedup_ok", "faults_attributed",
                      "phase_histograms_ok", "trace_linked",
                      "ledger_ingested"):
             if gate in rec:
                 out[gate] = bool(rec[gate])
+        recon = rec.get("reconciliation")
+        if isinstance(recon, dict) and isinstance(
+                recon.get("honesty_ratio_max"), (int, float)):
+            # lower is better; the binary codec's acceptance pins <= 1.02
+            out["honesty_ratio_max"] = float(recon["honesty_ratio_max"])
+        mt = rec.get("merge_throughput")
+        if isinstance(mt, dict) and isinstance(
+                mt.get("speedup"), (int, float)):
+            # machine-sensitive ratio (core count); the band still
+            # catches a collapse back toward 1.0
+            out["merge_speedup"] = float(mt["speedup"])
         kp = rec.get("kill_probes")
         if isinstance(kp, dict):
             for which in ("inplace", "failover"):
                 sub = kp.get(which)
                 if isinstance(sub, dict) and "ok" in sub:
                     out[f"kill_probe_{which}"] = bool(sub["ok"])
-        # round latency from the dedicated chaos-free run (lower is
-        # better); machine-sensitive like the throughput series — the
-        # band still catches a collapse
-        for m in ("round_p99_s", "round_p50_s"):
-            if isinstance(rec.get(m), (int, float)):
-                out[m] = float(rec[m])
+        # round latency is REPORTED in the record but gated only
+        # through the bounded boolean below: the chaos-free run's
+        # percentiles measure 16 processes scheduling on the CI host
+        # (the unchanged legacy codec spans ~3x run-to-run at p99 on a
+        # 4-core container), so a relative band would gate host load,
+        # not the plane — the RECOVERY / MANYPARTY stall gates made the
+        # same call for their raw stall times
+        if "round_latency_bounded" in rec:
+            out["round_latency_bounded"] = bool(
+                rec["round_latency_bounded"])
         return out
     if rec.get("mode") == "compare_sparseagg":  # SPARSEAGG_r*
         for gate in ("ok", "sparse_beats_dense"):

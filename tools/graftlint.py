@@ -25,6 +25,13 @@ Rules (docs/analysis.md has the catalog with examples):
 - GXL006  ``os.environ``/``os.getenv`` read in geomx_tpu/ outside
           config.py (knobs route through GeoConfig/_env so launch
           scripts and docs stay the single source of truth)
+- GX-WIRE-001  pickle use (``dumps``/``loads``/``dump``/``load``/
+          ``Unpickler``) anywhere in geomx_tpu/service/ — the host
+          plane's wire hot path speaks the fixed-layout v0x02 binary
+          codec; pickling there reintroduces the per-frame
+          serializer cost the native fast path removed (and, for
+          loads, an attack surface).  The ONLY sanctioned waivers
+          are the legacy-compat v0x01 codec paths in protocol.py.
 
 Traced-scope detection (documented heuristics, module-local):
 
@@ -101,7 +108,7 @@ _WALL_CLOCK_PATHS = {
 _REGISTRY_CALLS = {"get_registry", "log_event"}
 _REGISTRY_METHODS = {"inc", "observe", "labels"}
 
-_WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+_WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s-]+|all)")
 
 
 class LintFinding:
@@ -446,6 +453,36 @@ class ModuleLinter:
                        "config.py: route the knob through "
                        "GeoConfig/_env (or waive with a reason)")
 
+    def _check_service_pickle(self):
+        # GX-WIRE-001: geomx_tpu/service/ is the wire hot path — every
+        # frame a worker pushes crosses this code.  The v0x02 binary
+        # codec exists precisely so no pickle runs per frame; any new
+        # pickle use here silently reintroduces that serializer cost
+        # (and for loads, an arbitrary-object decode surface).  Only
+        # the legacy-compat v0x01 encode/decode in protocol.py carries
+        # a sanctioned waiver.
+        sp = os.sep + os.path.join("geomx_tpu", "service") + os.sep
+        if sp not in os.path.abspath(self.path):
+            return
+        names = ("dumps", "loads", "dump", "load", "Unpickler")
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute):
+                dotted = self._resolve(_dotted(node))
+            elif isinstance(node, ast.Name):
+                dotted = self.imports.get(node.id, "")
+            else:
+                continue
+            if not any(dotted == f"pickle.{n}"
+                       or dotted.endswith(f".pickle.{n}")
+                       for n in names):
+                continue
+            self._emit("GX-WIRE-001", node,
+                       f"pickle on the service wire path (`{dotted}`): "
+                       "the host plane ships the v0x02 binary codec — "
+                       "extend protocol's TLV/compact forms instead "
+                       "(waivers are reserved for the legacy-compat "
+                       "v0x01 codec)")
+
     def run(self) -> List[LintFinding]:
         self._collect_functions()
         self._collect_roots_and_calls()
@@ -455,6 +492,7 @@ class ModuleLinter:
                 self._check_traced_body(info)
         self._check_mutable_defaults()
         self._check_env_outside_config()
+        self._check_service_pickle()
         return self.findings
 
     @property
