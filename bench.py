@@ -141,6 +141,24 @@ Micro-modes:
       parser, and a merged 2-party WAN round trace with round_id-linked
       spans.  Artifacts (merged trace + JSONL event log) land in
       --out-dir.  CPU, no TPU needed.
+  bench.py --compare-mfu [--model=resnet20] [--steps=6] [--batch=32]
+           [--seq-len=128] [--out-dir=/tmp/...]
+      One JSON line for the compute-phase step-time engine
+      (docs/performance.md "Compute-phase engine"): the per-leaf optax
+      chain is DCE-verified GONE from the lowered weight update under
+      GEOMX_FUSED_OPTIM (fused bucket closure -> tpu_custom_call with
+      zero stablehlo.multiply; the full TPU-lowered train step shows
+      the same swap) with fused-vs-unfused params matching to the
+      documented FMA tolerance; the GEOMX_PRECISION=bf16 build's loss
+      trajectory tracks fp32 and the GX-DTYPE-001 precision audit both
+      passes a legitimate bf16 model and flags an fp32 imposter; the
+      loader's GEOMX_PREFETCH double-buffering drops the attributed
+      host_stall fraction (the four phase fractions still sum to ~1.0)
+      with prefetched batches bit-identical to synchronous ones; and
+      measured step time -> roofline MFU + bound verdict for BOTH
+      first-class workloads (ResNet-20 and the transformer sequence
+      classifier — the TRANSFORMER_r*.json trend series).  CPU, no
+      TPU needed.
   bench.py --attribute [--model=resnet20] [--iters=6] [--dcn-ms=100]
            [--batch=64] [--out-dir=/tmp/...]
       One JSON line for the step-time observatory (docs/telemetry.md):
@@ -834,6 +852,18 @@ def child_main():
     import jax
     if platform:
         jax.config.update("jax_platforms", platform)
+    else:
+        # BENCH_r05 root cause: the experimental 'axon' plugin registers
+        # at import time and its platform probe can wedge for the whole
+        # init budget.  With no explicit platform requested, drop the
+        # blocklisted plugins from the selection order before the first
+        # backend initializes (GEOMX_SCRUB_PLATFORMS gates; the parent's
+        # retry env enables it after an init timeout)
+        from geomx_tpu.runtime.backends import scrub_platforms
+        scrubbed = scrub_platforms(verbose=True)
+        if scrubbed:
+            _emit({"event": "platforms_scrubbed",
+                   "platforms": list(scrubbed)})
     _phase("jax_imported")
     devs = jax.devices()
     _phase("devices_enumerated")
@@ -3768,6 +3798,15 @@ def parent_main():
             # just like a dead tunnel, and a plain respawn re-reads both
             # (BENCH_r05 burned 2x480s on a hung init and published 0.0)
             extra = {"GEOMX_COMPILE_CACHE": "0", "XLA_FLAGS": ""}
+            if "GEOMX_SCRUB_PLATFORMS" not in os.environ:
+                # BENCH_r05 root cause: the first attempt wedged inside
+                # the experimental 'axon' platform probe and the retry
+                # re-probed the same wedge.  The retry now scrubs the
+                # blocklisted plugins (runtime/backends.py) so it lands
+                # on whatever healthy backend remains — an honest
+                # degraded number instead of a second 480s burn.  A
+                # user-set value (including =0) is never overridden.
+                extra["GEOMX_SCRUB_PLATFORMS"] = "1"
         init_ok, error = _run_attempt(init_timeout, total_timeout, results,
                                       on_event=print_snapshot,
                                       extra_env=extra)
@@ -5735,6 +5774,353 @@ def compare_sparseagg_main(argv):
     _emit(_compare_sparseagg(**kwargs))
 
 
+def _compare_mfu(model_name: str = "resnet20", steps: int = 6,
+                 batch: int = 32, seq_len: int = 128,
+                 out_dir: str = None):
+    """Compute-phase step-time engine acceptance (ISSUE 17) — module
+    docstring under --compare-mfu.  Four sections, one JSON line:
+
+    (a) fused optimizer: the per-leaf optax chain is structurally GONE
+        from the lowered update (DCE-verified: the fused bucket closure
+        lowers to tpu_custom_call with ZERO stablehlo.multiply, the
+        unfused chain to zero custom calls and many multiplies; the
+        FULL train step cross-lowered for TPU shows the same swap), and
+        a short fused-vs-unfused training run lands the same params;
+    (b) precision: the bf16 build's loss trajectory tracks fp32, the
+        GX-DTYPE-001 precision audit is clean on a legitimately-built
+        bf16 model (classifier head exempt) AND flags an fp32 model
+        declared bf16 — the audit has teeth;
+    (c) prefetch: host_stall fraction (telemetry/attribution.py) drops
+        when the loader's double-buffered prefetch is on, phase
+        fractions still sum to ~1.0, and prefetched batches are
+        bit-identical to synchronous ones;
+    (d) roofline: measured step time -> MFU + bound verdict for BOTH
+        first-class workloads (ResNet-20 CIFAR10 and the transformer
+        sequence classifier) — the record is the TRANSFORMER_r*.json
+        trend series.  CPU-mesh runnable; no TPU needed.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from geomx_tpu.analysis.hlo import count_ops, lower_text
+    from geomx_tpu.analysis.passes import audit_precision
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.data import GeoDataLoader
+    from geomx_tpu.models import get_model
+    from geomx_tpu.ops.optim_pallas import (fused_apply, fused_optimizer,
+                                            unfused_apply)
+    from geomx_tpu.sync import get_sync_algorithm
+    from geomx_tpu.telemetry.attribution import attribute_trace
+    from geomx_tpu.telemetry.roofline import trainer_roofline
+    from geomx_tpu.topology import HiPSTopology
+    from geomx_tpu.train import Trainer
+    from geomx_tpu.utils.profiler import get_profiler
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise RuntimeError(
+            "--compare-mfu needs the 8-virtual-device mesh (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    out_dir = out_dir or tempfile.mkdtemp(prefix="geomx_mfu_")
+    os.makedirs(out_dir, exist_ok=True)
+    topo = HiPSTopology(num_parties=2, workers_per_party=4)
+    out = {"mode": "compare_mfu", "model": model_name, "steps": steps,
+           "batch": batch, "seq_len": seq_len,
+           "device": {"device_kind": devs[0].device_kind,
+                      "n_devices": len(devs)}}
+
+    local_b = max(1, batch // 8)
+    rng = np.random.RandomState(0)
+    x_img = (rng.rand(steps + 2, 2, 4, local_b, 32, 32, 3)
+             * 255).astype(np.uint8)
+    y_img = rng.randint(0, 10,
+                        size=(steps + 2, 2, 4, local_b)).astype(np.int32)
+
+    def _trainer(cfg, tx, precision=None, model=None):
+        model = model if model is not None else get_model(
+            model_name, num_classes=10, precision=precision)
+        return Trainer(model, topo, tx, sync=get_sync_algorithm(cfg),
+                       config=cfg, donate=False)
+
+    # -- (a) fused optimizer: DCE structure swap + params match -----------
+    # a1: the update closure alone, over two buckets (one odd tail).
+    # Contract (ops/optim_pallas.py): fused lowers to one
+    # tpu_custom_call per bucket and ZERO stablehlo.multiply (bias
+    # corrections are stablehlo.power); the per-leaf chain lowers to
+    # zero custom calls and a multiply per hyperparameter per bucket.
+    fo = fused_optimizer("adam", learning_rate=1e-3)
+    buckets = [jnp.zeros((n,), jnp.float32) for n in (4096, 1037)]
+    grads_b = [jnp.full((n,), 1e-3, jnp.float32) for n in (4096, 1037)]
+    ostate = fo.init(buckets)
+
+    def _fused_closure(ps, gs, st):
+        return fused_apply(fo.spec, ps, gs, st, interpret=False)
+
+    def _unfused_closure(ps, gs, st):
+        return unfused_apply(fo, ps, gs, st)
+
+    def _dce(fn):
+        txt = lower_text(fn, buckets, grads_b, ostate)
+        c = count_ops(txt, ("stablehlo.multiply", "stablehlo.power"))
+        return {"custom_calls": txt.count("tpu_custom_call"),
+                "multiplies": c.get("multiply", 0),
+                "powers": c.get("power", 0)}
+
+    dce_f, dce_u = _dce(_fused_closure), _dce(_unfused_closure)
+
+    # a2: the FULL train step, cross-lowered for TPU on the CPU mesh
+    # (GEOMX_FUSED_OPTIM_INTERPRET=0 forces native Mosaic lowering; such
+    # a build lowers anywhere but only RUNS on TPU — we only lower it).
+    def _step_custom_calls(fused, interpret_env=None):
+        old = os.environ.get("GEOMX_FUSED_OPTIM_INTERPRET")
+        if interpret_env is not None:
+            os.environ["GEOMX_FUSED_OPTIM_INTERPRET"] = interpret_env
+        try:
+            cfg = GeoConfig(num_parties=2, workers_per_party=4,
+                            bucket_bytes=1 << 20, fused_optim=fused)
+            tr = _trainer(cfg, fused_optimizer("sgd", learning_rate=0.1,
+                                               momentum=0.9))
+        finally:
+            if interpret_env is not None:
+                if old is None:
+                    os.environ.pop("GEOMX_FUSED_OPTIM_INTERPRET", None)
+                else:
+                    os.environ["GEOMX_FUSED_OPTIM_INTERPRET"] = old
+        st = tr.init_state(jax.random.PRNGKey(0), x_img[0, 0, 0, :2])
+        sharding = topo.batch_sharding(tr.mesh)
+        xb = jax.device_put(x_img[0], sharding)
+        yb = jax.device_put(y_img[0], sharding)
+        return lower_text(tr.train_step, st, xb,
+                          yb).count("tpu_custom_call")
+
+    step_fused = _step_custom_calls(True, interpret_env="0")
+    step_unfused = _step_custom_calls(False)
+
+    # a3: fused (interpret mode on CPU) vs per-leaf chain, short run.
+    # Accumulated FMA-contraction drift through adam/momentum is the
+    # documented tolerance (ops/optim_pallas.py): 1e-4 over this horizon.
+    def _fit_params(fused):
+        cfg = GeoConfig(num_parties=2, workers_per_party=4,
+                        bucket_bytes=1 << 20, fused_optim=fused)
+        tr = _trainer(cfg, fused_optimizer("sgd", learning_rate=0.05,
+                                           momentum=0.9))
+        st = tr.init_state(jax.random.PRNGKey(0), x_img[0, 0, 0, :2])
+        sharding = topo.batch_sharding(tr.mesh)
+        for s in range(steps):
+            st, m = tr.train_step(st,
+                                  jax.device_put(x_img[s], sharding),
+                                  jax.device_put(y_img[s], sharding))
+        jax.block_until_ready(m["loss"])
+        return jax.device_get(st.params)
+
+    pf, pu = _fit_params(True), _fit_params(False)
+    param_max_diff = max(
+        float(np.max(np.abs(np.asarray(a, np.float64)
+                            - np.asarray(b, np.float64))))
+        for a, b in zip(jax.tree.leaves(pf), jax.tree.leaves(pu)))
+    out["fused_optimizer"] = {
+        "bucket_update": {"fused": dce_f, "unfused": dce_u},
+        "step_custom_calls": {"fused": step_fused,
+                              "unfused": step_unfused},
+        "per_leaf_chain_gone": bool(
+            dce_f["custom_calls"] >= 1 and dce_f["multiplies"] == 0
+            and dce_u["custom_calls"] == 0 and dce_u["multiplies"] > 0
+            and step_fused >= 1 and step_unfused == 0),
+        "param_max_diff": param_max_diff,
+        "params_match": bool(param_max_diff < 1e-4),
+    }
+
+    # -- (b) precision: bf16 trajectory + audit teeth ---------------------
+    def _loss_traj(precision):
+        cfg = GeoConfig(num_parties=2, workers_per_party=4,
+                        precision=precision)
+        tr = _trainer(cfg, optax.sgd(0.1, momentum=0.9),
+                      precision=precision)
+        st = tr.init_state(jax.random.PRNGKey(0), x_img[0, 0, 0, :2])
+        sharding = topo.batch_sharding(tr.mesh)
+        losses = []
+        for s in range(steps):
+            st, m = tr.train_step(st,
+                                  jax.device_put(x_img[s], sharding),
+                                  jax.device_put(y_img[s], sharding))
+            losses.append(float(m["loss"]))
+        return losses
+
+    traj_fp32 = _loss_traj("fp32")
+    traj_bf16 = _loss_traj("bf16")
+    loss_max_diff = max(abs(a - b)
+                        for a, b in zip(traj_fp32, traj_bf16))
+
+    sample_x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+
+    def _audit(model_precision):
+        mdl = get_model(model_name, num_classes=10,
+                        precision=model_precision)
+        vs = jax.eval_shape(lambda: mdl.init(jax.random.PRNGKey(0),
+                                             sample_x, train=False))
+        vs = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), vs)
+        return audit_precision(
+            lambda xx: mdl.apply(vs, xx, train=False), sample_x,
+            precision="bf16", allowed_fp32_sites=1)
+
+    clean = _audit("bf16")            # legit bf16 build: head exempt
+    leaks = _audit("fp32")            # fp32 model declared bf16: leaks
+    out["precision"] = {
+        "loss_fp32": [round(v, 6) for v in traj_fp32],
+        "loss_bf16": [round(v, 6) for v in traj_bf16],
+        "loss_max_diff": round(loss_max_diff, 6),
+        "tolerance": 0.05,
+        "bf16_matches_fp32": bool(loss_max_diff < 0.05),
+        "audit_findings_bf16_model": [f.message for f in clean],
+        "audit_findings_fp32_model": len(leaks),
+        "dtype_audit_clean": not clean,
+        "fp32_leak_detected": bool(leaks),
+    }
+
+    # -- (c) prefetch: host_stall drops, determinism ----------------------
+    pf_b = 16
+    pf_steps = 8
+    n_pf = 8 * pf_b * pf_steps
+    x_pf = (rng.rand(n_pf, 32, 32, 3) * 255).astype(np.uint8)
+    y_pf = rng.randint(0, 10, size=(n_pf,)).astype(np.int32)
+
+    def _stall(prefetch):
+        cfg = GeoConfig(num_parties=2, workers_per_party=4,
+                        prefetch=prefetch)
+        tr = _trainer(cfg, optax.sgd(0.1, momentum=0.9), model=get_model(
+            "cnn", num_classes=10))
+        sharding = topo.batch_sharding(tr.mesh)
+        loader = GeoDataLoader(x_pf, y_pf, topo, batch_size=pf_b,
+                               seed=3, sharding=sharding, augment=True)
+        st = tr.init_state(jax.random.PRNGKey(0), x_pf[:2])
+        xb, yb = next(iter(loader.epoch(0, prefetch=0)))
+        st, m = tr.train_step(st, xb, yb)          # compile + warm
+        jax.block_until_ready(m["loss"])
+        prof = get_profiler()
+        prof.set_state(True)
+        since = prof.now_us()
+        st, _recs = tr.fit(st, loader, epochs=1)
+        prof.set_state(False)
+        att = attribute_trace(prof.to_doc(), since_us=since)
+        with open(os.path.join(out_dir,
+                               f"attribution_prefetch{prefetch}.json"),
+                  "w") as f:
+            json.dump(att, f, indent=2, default=str)
+        return att
+
+    att_off = _stall(0)
+    att_on = _stall(2)
+    sum_off = sum(att_off["summary"].values())
+    sum_on = sum(att_on["summary"].values())
+
+    la = GeoDataLoader(x_pf, y_pf, topo, batch_size=pf_b, seed=3,
+                       augment=True)
+    lb = GeoDataLoader(x_pf, y_pf, topo, batch_size=pf_b, seed=3,
+                       augment=True)
+    deterministic = all(
+        np.array_equal(np.asarray(xa), np.asarray(xb))
+        and np.array_equal(np.asarray(ya), np.asarray(yb))
+        for (xa, ya), (xb, yb) in zip(la.epoch(1, prefetch=0),
+                                      lb.epoch(1, prefetch=3)))
+    stall_off = att_off["summary"]["host_stall"]
+    stall_on = att_on["summary"]["host_stall"]
+    out["prefetch"] = {
+        "host_stall_fraction_off": round(stall_off, 4),
+        "host_stall_fraction_on": round(stall_on, 4),
+        "host_stall_drops": bool(stall_on < stall_off),
+        "phase_fractions_off": {k: round(v, 4)
+                                for k, v in att_off["summary"].items()},
+        "phase_fractions_on": {k: round(v, 4)
+                               for k, v in att_on["summary"].items()},
+        "phase_sum_ok": bool(abs(sum_off - 1.0) < 1e-6
+                             and abs(sum_on - 1.0) < 1e-6),
+        "prefetch_deterministic": bool(deterministic),
+    }
+
+    # -- (d) roofline MFU for both first-class workloads ------------------
+    def _roofline(workload):
+        if workload == "transformer":
+            mdl = get_model("transformer", num_classes=10)
+            xs = rng.randint(0, 256, size=(steps + 2, 2, 4, local_b,
+                                           seq_len)).astype(np.int32)
+            ys = rng.randint(0, 10, size=(steps + 2, 2, 4,
+                                          local_b)).astype(np.int32)
+        else:
+            mdl = get_model(workload, num_classes=10)
+            xs, ys = x_img, y_img
+        cfg = GeoConfig(num_parties=2, workers_per_party=4)
+        tr = _trainer(cfg, optax.sgd(0.1, momentum=0.9), model=mdl)
+        st = tr.init_state(jax.random.PRNGKey(0), xs[0, 0, 0, :2])
+        sharding = topo.batch_sharding(tr.mesh)
+        times = []
+        for s in range(steps + 2):
+            xb = jax.device_put(xs[s], sharding)
+            yb = jax.device_put(ys[s], sharding)
+            t0 = time.perf_counter()
+            st, m = tr.train_step(st, xb, yb)
+            jax.block_until_ready(m["loss"])
+            times.append(time.perf_counter() - t0)
+        step_s = float(np.median(times[2:]))
+        roof = trainer_roofline(tr, st, xb, yb, step_time_s=step_s)
+        return {
+            "step_time_ms": round(step_s * 1e3, 3),
+            "samples_per_sec": round(8 * local_b / step_s, 1),
+            "mfu": (round(roof["mfu"], 6)
+                    if roof.get("mfu") is not None else None),
+            "arithmetic_intensity": (
+                round(roof["arithmetic_intensity"], 3)
+                if roof.get("arithmetic_intensity") is not None
+                else None),
+            "bound": roof["bound"],
+            "cost_analysis_available": roof["cost_analysis_available"],
+            "peak_calibrated": roof["peak_calibrated"],
+        }
+
+    out["roofline"] = {
+        "resnet20": _roofline(model_name),
+        "transformer": _roofline("transformer"),
+    }
+    rooflines_present = all(
+        r["step_time_ms"] > 0 for r in out["roofline"].values())
+
+    out["per_leaf_chain_gone"] = out["fused_optimizer"][
+        "per_leaf_chain_gone"]
+    out["params_match"] = out["fused_optimizer"]["params_match"]
+    out["bf16_matches_fp32"] = out["precision"]["bf16_matches_fp32"]
+    out["host_stall_drops"] = out["prefetch"]["host_stall_drops"]
+    out["phase_sum_ok"] = out["prefetch"]["phase_sum_ok"]
+    out["artifacts"] = {"out_dir": out_dir}
+    out["ok"] = bool(
+        out["per_leaf_chain_gone"] and out["params_match"]
+        and out["bf16_matches_fp32"]
+        and out["precision"]["dtype_audit_clean"]
+        and out["precision"]["fp32_leak_detected"]
+        and out["host_stall_drops"] and out["phase_sum_ok"]
+        and out["prefetch"]["prefetch_deterministic"]
+        and rooflines_present)
+    with open(os.path.join(out_dir, "mfu_record.json"), "w") as f:
+        json.dump(out, f, indent=2, default=str)
+    return out
+
+
+def compare_mfu_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--model="):
+            kwargs["model_name"] = a.split("=", 1)[1]
+        elif a.startswith("--steps="):
+            kwargs["steps"] = int(a.split("=", 1)[1])
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--seq-len="):
+            kwargs["seq_len"] = int(a.split("=", 1)[1])
+        elif a.startswith("--out-dir="):
+            kwargs["out_dir"] = a.split("=", 1)[1]
+    _emit(_compare_mfu(**kwargs))
+
+
 def main():
     if "--compare-kernels" in sys.argv:
         # kernel micro-mode: in-process, single device is enough (no
@@ -5813,6 +6199,18 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=4").strip()
         compare_sparseagg_main(sys.argv[1:])
+    elif "--compare-mfu" in sys.argv:
+        # compute-phase engine acceptance: in-process on the CPU
+        # backend with the 2x4 virtual mesh (8 devices, env before the
+        # first jax import) — the fused-optimizer DCE section
+        # cross-lowers the step for TPU, it never executes it
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        compare_mfu_main(sys.argv[1:])
     elif "--compare-manyparty" in sys.argv:
         # many-party sharded-global-tier acceptance: pure service-plane
         # (sockets + numpy, 16+ worker threads), no jax mesh

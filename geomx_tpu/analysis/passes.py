@@ -414,6 +414,28 @@ def audit_dtype_flow(fn: Callable, *args,
     return DtypeFlowPass().run(jx, AuditContext(compute_dtype=compute_dtype))
 
 
+def audit_precision(fn: Callable, *args, precision: str = "bf16",
+                    allowed_fp32_sites: int = 0) -> List[Finding]:
+    """GX-DTYPE-001 for the first-class precision mode
+    (``GEOMX_PRECISION``): audit a forward/loss closure built for
+    ``precision`` and return the fp32 heavy-compute leaks.
+
+    ``allowed_fp32_sites`` drops that many TRAILING findings before
+    returning: the zoo's models intentionally compute the classifier
+    head in fp32 (the last heavy op in the forward — softmax stability
+    next to an fp32 loss), so a legitimately-built bf16 model audits
+    clean with ``allowed_fp32_sites=1`` while a leak anywhere earlier
+    in the network still surfaces.  ``precision="fp32"`` always returns
+    [] (there is no declaration to violate)."""
+    if str(precision).lower() in ("fp32", "float32", "f32"):
+        return []
+    findings = audit_dtype_flow(fn, *args, compute_dtype="bfloat16")
+    if allowed_fp32_sites > 0:
+        findings = findings[:-allowed_fp32_sites] \
+            if len(findings) > allowed_fp32_sites else []
+    return findings
+
+
 def _traced_allreduce_jaxpr(compressor, params, num_parties: int = 2):
     """Trace ``compressor.allreduce`` over a ``num_parties``-wide dc
     mesh (virtual devices are fine: the jaxpr is platform-independent),

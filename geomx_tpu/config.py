@@ -96,6 +96,25 @@ class GeoConfig:
     # composes).  Planned TPU default once hardware parity lands.
     zero: bool = False
 
+    # ---- compute-phase engine (train/step.py, ops/optim_pallas.py,
+    # data/loader.py; docs/performance.md "Compute-phase engine")
+    # numeric precision of the model's heavy compute: "fp32" (default)
+    # or "bf16" (fp32 master weights + bf16 activations/matmuls; loss
+    # scaling is unnecessary by construction — the master weights, the
+    # gradients and the loss all stay fp32, and bf16 shares fp32's
+    # exponent range so activations cannot underflow the way fp16 does)
+    precision: str = "fp32"
+    # fused optimizer apply: one Pallas kernel per flat bucket replaces
+    # the per-leaf optax chain (SGD-momentum / Adam); requires an
+    # optimizer built by ops.optim_pallas.fused_optimizer and the
+    # bucketed dc-tier engine (GEOMX_BUCKET_BYTES > 0)
+    fused_optim: bool = False
+    # input-pipeline prefetch depth: how many assembled+device_put
+    # batches the loader's producer thread keeps in flight ahead of the
+    # train step (data/loader.py).  2 = double buffering (default);
+    # 0 = synchronous (the host_stall baseline)
+    prefetch: int = 2
+
     # ---- MultiGPS parameter sharding
     # tensors >= this many elements are sharded across the global-server axis
     # (reference MXNET_KVSTORE_BIGARRAY_BOUND, src/kvstore/kvstore_dist.h:69)
@@ -222,6 +241,9 @@ class GeoConfig:
                                 lambda s: int(float(s))),
             pipeline_dcasgd=_env(["GEOMX_PIPELINE_DCASGD"], 0.0, float),
             zero=_env_bool(["GEOMX_ZERO"], False),
+            precision=_env(["GEOMX_PRECISION"], "fp32", str),
+            fused_optim=_env_bool(["GEOMX_FUSED_OPTIM"], False),
+            prefetch=_env(["GEOMX_PREFETCH"], 2, lambda s: int(float(s))),
             bigarray_bound=_env(
                 ["GEOMX_BIGARRAY_BOUND", "MXNET_KVSTORE_BIGARRAY_BOUND"],
                 1_000_000, int),

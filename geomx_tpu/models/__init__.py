@@ -4,6 +4,8 @@ The reference's demo workloads are Gluon CNNs on MNIST/FashionMNIST/CIFAR10
 (examples/cnn*.py); the flagship target is ResNet on CIFAR10 (BASELINE.md).
 """
 
+import jax.numpy as jnp
+
 from geomx_tpu.models.cnn import GeoCNN
 from geomx_tpu.models.mlp import MLP, AlexNet
 from geomx_tpu.models.resnet import (ResNet, ResNet18, ResNet20, ResNet32,
@@ -14,28 +16,40 @@ __all__ = ["GeoCNN", "MLP", "AlexNet",
            "ResNet", "ResNet20", "ResNet32", "ResNet56", "ResNet18",
            "SeqClassifier", "get_model"]
 
+# GEOMX_PRECISION -> the models' compute dtype.  Params always stay
+# fp32 (flax casts per-op from the fp32 masters); every model's
+# classifier head computes and returns fp32 regardless (train/step.py).
+_PRECISION_DTYPE = {"fp32": jnp.float32, "bf16": jnp.bfloat16}
 
-def get_model(name: str, num_classes: int = 10):
+
+def get_model(name: str, num_classes: int = 10, precision: str = None):
+    """Build a zoo model.  ``precision`` (``"fp32"``/``"bf16"``, as
+    resolved by ``train.step.resolve_precision``) pins the compute
+    dtype explicitly; the default ``None`` keeps each model's
+    historical default (byte-identical traces)."""
     name = name.lower()
+    dt = {}
+    if precision is not None:
+        dt = {"dtype": _PRECISION_DTYPE[precision]}
     if name in ("cnn", "geocnn", "lenet"):
-        return GeoCNN(num_classes=num_classes)
+        return GeoCNN(num_classes=num_classes, **dt)
     if name == "mlp":
-        return MLP(num_classes=num_classes)
+        return MLP(num_classes=num_classes, **dt)
     if name == "alexnet":
-        return AlexNet(num_classes=num_classes)
+        return AlexNet(num_classes=num_classes, **dt)
     if name == "resnet20":
-        return ResNet20(num_classes=num_classes)
+        return ResNet20(num_classes=num_classes, **dt)
     if name in ("resnet20_s2d", "resnet20-s2d"):
         # TPU-optimized variant: 2x2 space-to-depth stem + MXU-friendly
         # transition shortcuts (see models/resnet.py)
         return ResNet20(num_classes=num_classes, space_to_depth=True,
-                        mxu_shortcuts=True)
+                        mxu_shortcuts=True, **dt)
     if name == "resnet32":
-        return ResNet32(num_classes=num_classes)
+        return ResNet32(num_classes=num_classes, **dt)
     if name == "resnet56":
-        return ResNet56(num_classes=num_classes)
+        return ResNet56(num_classes=num_classes, **dt)
     if name == "resnet18":
-        return ResNet18(num_classes=num_classes)
+        return ResNet18(num_classes=num_classes, **dt)
     if name in ("seq", "seq_classifier", "transformer"):
-        return SeqClassifier(num_classes=num_classes)
+        return SeqClassifier(num_classes=num_classes, **dt)
     raise ValueError(f"Unknown model: {name!r}")

@@ -5,26 +5,40 @@ MaxPool(2,2) -> Conv(32, 5x5, relu) -> MaxPool(2,2) -> Dense(256, relu) ->
 Dense(128, relu) -> Dense(10), Xavier init.  Inputs are NHWC (TPU-native
 layout; the reference uses NCHW because cuDNN prefers it — XLA on TPU
 prefers channels-last).
+
+``dtype`` is the compute dtype (bf16 under ``GEOMX_PRECISION=bf16``);
+params stay fp32 (flax casts per-op) and the classifier head computes
+and returns fp32 like every model in the zoo.  The default ``None``
+keeps flax's promotion rules — byte-identical to the historical trace.
 """
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 
 
 class GeoCNN(nn.Module):
     num_classes: int = 10
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         init = nn.initializers.xavier_uniform()
-        x = nn.Conv(16, (5, 5), kernel_init=init)(x)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        x = nn.Conv(16, (5, 5), kernel_init=init, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(32, (5, 5), kernel_init=init)(x)
+        x = nn.Conv(32, (5, 5), kernel_init=init, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(256, kernel_init=init)(x))
-        x = nn.relu(nn.Dense(128, kernel_init=init)(x))
-        return nn.Dense(self.num_classes, kernel_init=init)(x)
+        x = nn.relu(nn.Dense(256, kernel_init=init, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(128, kernel_init=init, dtype=self.dtype)(x))
+        head_dtype = None if self.dtype is None else jnp.float32
+        x = nn.Dense(self.num_classes, kernel_init=init,
+                     dtype=head_dtype)(x)
+        return x if self.dtype is None else x.astype(jnp.float32)

@@ -17,6 +17,9 @@ makes one HBM round trip:
 - ``flash_attention`` / ``fused_attention``: online-softmax attention
   for the long-context path — the [L, L] score matrix never reaches
   HBM (the reference has no attention operator at all).
+- ``fused_apply`` (optim_pallas): SGD-momentum/Adam applied over the
+  flat fp32 buckets in one VMEM-resident pass per bucket, replacing
+  the per-leaf optax chain on the hot path (``GEOMX_FUSED_OPTIM``).
 
 Kernels run natively on TPU and in Pallas interpret mode elsewhere
 (tests exercise them on CPU via interpret mode).
@@ -32,6 +35,12 @@ from geomx_tpu.ops.flash_attention import (flash_attention,
                                            flash_attention_with_lse,
                                            fused_attention,
                                            fused_attention_supported)
+from geomx_tpu.ops.optim_pallas import (FusedOptimSpec, FusedOptimizer,
+                                        fused_adam, fused_apply,
+                                        fused_optim_enabled,
+                                        fused_optimizer,
+                                        fused_sgd_momentum, fused_spec_of,
+                                        unfused_apply)
 from geomx_tpu.ops.twobit_pallas import (dequantize_2bit, pallas_supported,
                                          quantize_2bit)
 
@@ -40,4 +49,7 @@ __all__ = ["quantize_2bit", "dequantize_2bit", "pallas_supported",
            "fused_flatten", "fused_unflatten",
            "flash_attention", "flash_attention_bwd",
            "flash_attention_with_lse", "fused_attention",
-           "fused_attention_supported"]
+           "fused_attention_supported",
+           "FusedOptimSpec", "FusedOptimizer", "fused_optimizer",
+           "fused_spec_of", "fused_optim_enabled", "fused_apply",
+           "unfused_apply", "fused_sgd_momentum", "fused_adam"]

@@ -1,12 +1,17 @@
-"""Native (C++) host runtime bindings.
+"""Native (C++) host runtime bindings + backend selection hygiene.
 
 The reference's transport core is native C++ (ps-lite); here the
 host-side pieces that benefit from native code — the priority send queue
 and the TSEngine scheduler state machine — are C++ (native/
 geops_runtime.cpp) behind ctypes, with automatic build-on-first-use and
 pure-Python fallbacks (geomx_tpu.transport) when no toolchain exists.
+
+``backends.scrub_platforms`` removes wedge-prone experimental JAX
+platform plugins from the backend selection order
+(``GEOMX_SCRUB_PLATFORMS``; the BENCH_r05 root cause).
 """
 
+from geomx_tpu.runtime.backends import scrub_list, scrub_platforms
 from geomx_tpu.runtime.native import (NativePriorityQueue,
                                       NativeRecordIOReader,
                                       NativeRecordIOWriter, NativeTSEngine,
@@ -14,4 +19,4 @@ from geomx_tpu.runtime.native import (NativePriorityQueue,
 
 __all__ = ["NativePriorityQueue", "NativeRecordIOReader",
            "NativeRecordIOWriter", "NativeTSEngine", "load_native",
-           "native_available"]
+           "native_available", "scrub_platforms", "scrub_list"]

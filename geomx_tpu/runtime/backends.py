@@ -1,0 +1,107 @@
+"""JAX backend selection hygiene for bench/CI child processes.
+
+BENCH_r05 published 0.0 because backend init wedged for 2x480s: the
+SIGUSR1 forensics named the frame stuck inside the experimental 'axon'
+TPU-tunnel plugin's platform probe ("Platform 'axon' is experimental
+and not all JAX functionality may be correctly supported!"), which
+registers itself at import time and overrides ``JAX_PLATFORMS``.  A
+wedged *probe* is not a wedged *machine* — the CPU (and often the real
+TPU runtime) would have initialized fine, so the honest degraded number
+was available the whole time.
+
+:func:`scrub_platforms` removes such platforms from JAX's selection
+order before the first backend initializes.  Gated by
+``GEOMX_SCRUB_PLATFORMS``:
+
+- unset / ``0`` / ``none`` -> disabled (probe everything — the
+  default, because 'axon' is also the TPU tunnel: scrubbing it
+  up-front would forfeit real TPU numbers on healthy machines);
+- ``1`` / ``default``       -> scrub the default blocklist (``axon``);
+- ``a,b``                   -> scrub exactly those platform names.
+
+The bench parent (bench.py ``parent_main``) leaves the first attempt
+unscrubbed — a healthy plugin should get its chance to bring up real
+TPU devices — and injects ``GEOMX_SCRUB_PLATFORMS=axon`` into the
+retry env after an init-timeout (unless the user already set the
+variable), so a wedged probe costs one attempt instead of the whole
+run and the retry lands an honest degraded number.  An explicit
+``JAX_PLATFORMS`` naming a scrubbed platform wins: the user asked for
+it by name.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional, Sequence, Tuple
+
+# platforms whose import-time registration has wedged backend init in
+# the field (BENCH_r05); what GEOMX_SCRUB_PLATFORMS=1 scrubs
+DEFAULT_SCRUB = ("axon",)
+
+_DISABLED = ("0", "none", "off", "false")
+_DEFAULT_ON = ("1", "default", "on", "true")
+
+
+def scrub_list(env: Optional[dict] = None) -> Tuple[str, ...]:
+    """The platform names to scrub, resolved from
+    ``GEOMX_SCRUB_PLATFORMS`` (see module docstring)."""
+    env = os.environ if env is None else env
+    raw = env.get("GEOMX_SCRUB_PLATFORMS")
+    if raw is None:
+        return ()
+    raw = raw.strip()
+    if raw.lower() in _DISABLED or not raw:
+        return ()
+    if raw.lower() in _DEFAULT_ON:
+        return DEFAULT_SCRUB
+    return tuple(p.strip().lower() for p in raw.split(",") if p.strip())
+
+
+def registered_platforms() -> Tuple[str, ...]:
+    """Platform names currently registered with the xla_bridge factory
+    table (defensive: returns () if the private layout moved)."""
+    try:
+        from jax._src import xla_bridge
+        return tuple(xla_bridge._backend_factories.keys())
+    except Exception:
+        return ()
+
+
+def scrub_platforms(scrub: Optional[Iterable[str]] = None,
+                    verbose: bool = False) -> Tuple[str, ...]:
+    """Pin ``jax_platforms`` to the registered platforms minus the
+    scrub set, so a blocklisted plugin's probe never runs.
+
+    Must be called after ``import jax`` but before the first backend
+    initializes (first array op / ``jax.devices()``).  Returns the
+    names actually scrubbed (empty when disabled, when nothing matched,
+    or when the user's explicit ``JAX_PLATFORMS`` already names a
+    scrubbed platform — an explicit request always wins)."""
+    if scrub is None:
+        scrub = scrub_list()
+    scrub = tuple(s.lower() for s in scrub)
+    if not scrub:
+        return ()
+    # graftlint: disable=GXL006 — JAX's own variable, not a GEOMX knob
+    explicit = os.environ.get("JAX_PLATFORMS", "")
+    explicit_names = {p.strip().lower()
+                      for p in explicit.split(",") if p.strip()}
+    if explicit_names & set(scrub):
+        return ()
+    import jax
+    registered = registered_platforms()
+    if not registered:
+        return ()
+    hit = tuple(p for p in registered if p.lower() in scrub)
+    if not hit:
+        return ()
+    keep = [p for p in registered if p.lower() not in scrub]
+    # cpu last: jax treats the order as priority and cpu is the
+    # fallback of last resort
+    keep.sort(key=lambda p: (p.lower() == "cpu", p.lower()))
+    jax.config.update("jax_platforms", ",".join(keep))
+    if verbose:
+        import sys
+        print(f"geomx: scrubbed platform probe for {hit} "
+              f"(selection order: {','.join(keep)})", file=sys.stderr)
+    return hit

@@ -41,15 +41,55 @@ def _norm_input(x: jax.Array) -> jax.Array:
     return x.astype(jnp.float32) / 255.0
 
 
-def make_loss_fn(apply_fn: Callable, mutable_keys=("batch_stats",)):
+def resolve_precision(config=None) -> str:
+    """The compute precision for this build: ``"fp32"`` or ``"bf16"``.
+
+    Static, resolved at build time like every other step-shaping knob
+    (``GeoConfig(precision=...)`` wins; ``GEOMX_PRECISION`` covers
+    config-less call sites).  bf16 means fp32 master weights with bf16
+    activations/matmuls — the loss, the gradients and the optimizer
+    state all stay fp32, which is why no loss scaling exists anywhere
+    in this mode: nothing that accumulates ever leaves fp32, and bf16
+    shares fp32's exponent range so activations cannot underflow the
+    way fp16 activations do (docs/performance.md)."""
+    if config is not None:
+        raw = getattr(config, "precision", "fp32")
+    else:
+        import os
+        # the knob IS routed through GeoConfig.from_env; this is the
+        # fallback for callers without a config (get_model factories)
+        # graftlint: disable=GXL006 — config-less surface
+        raw = os.environ.get("GEOMX_PRECISION", "fp32")
+    alias = {"fp32": "fp32", "float32": "fp32", "f32": "fp32",
+             "bf16": "bf16", "bfloat16": "bf16"}
+    key = str(raw).lower()
+    if key not in alias:
+        raise ValueError(
+            f"unknown precision {raw!r}: expected 'fp32' or 'bf16' "
+            "(GEOMX_PRECISION / GeoConfig.precision)")
+    return alias[key]
+
+
+def make_loss_fn(apply_fn: Callable, mutable_keys=("batch_stats",),
+                 compute_dtype=None):
     """Standard classification loss closure over a flax apply_fn.
 
     Images arrive uint8 NHWC; normalization to [0,1] happens on-device so
     the host->device transfer stays 1 byte/pixel.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) casts the normalized
+    float inputs before the forward — the entry half of the bf16 mode;
+    the models cast their own internals per-layer from the fp32 master
+    params.  Integer token-id inputs pass through regardless.  The
+    default (``None``) traces exactly the historical ops, keeping the
+    disabled-path jaxpr byte-identical (tests/test_telemetry.py).
     """
 
     def loss_fn(params, model_state, x, y):
         x = _norm_input(x)
+        if compute_dtype is not None and jnp.issubdtype(x.dtype,
+                                                        jnp.floating):
+            x = x.astype(compute_dtype)
         variables = {"params": params, **model_state}
         mut = [k for k in mutable_keys if k in model_state]
         if mut:
@@ -215,6 +255,65 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                 "psum_scatter; the configured worker compressor "
                 f"({wc.name}) is bypassed", stacklevel=2)
 
+    # fused optimizer apply (ops/optim_pallas.py): the same static-gate
+    # contract — resolved here at build time, and with the gate off the
+    # update path below traces exactly the historical per-leaf optax
+    # chain, keeping the default jaxpr byte-identical
+    from geomx_tpu.ops.optim_pallas import (fused_apply, fused_optim_enabled,
+                                            fused_spec_of)
+    fopt_spec = None
+    fopt_bucketer = None
+    fopt_interp = False
+    if fused_optim_enabled(config):
+        fopt_spec = fused_spec_of(tx)
+        if fopt_spec is None:
+            # fail loudly (same contract as the composition checks
+            # above): a plain optax closure hides its hyperparameters,
+            # and silently falling back would report fused numbers from
+            # an unfused run
+            raise ValueError(
+                "GEOMX_FUSED_OPTIM requires an optimizer built by "
+                "ops.optim_pallas.fused_optimizer (the kernels need the "
+                "static hyperparameters a plain optax closure hides)")
+        if mgps is not None:
+            raise ValueError(
+                "GEOMX_FUSED_OPTIM does not compose with GEOMX_MULTI_GPS: "
+                "the mixed shard/replicated per-leaf layout does not "
+                "flatten into uniform buckets; use GEOMX_ZERO for a "
+                "sharded fused update")
+        if zplan is None:
+            from geomx_tpu.compression.bucketing import BucketedCompressor
+            from geomx_tpu.sync.pipeline import PipelinedCompressor
+            dc = getattr(sync, "dc_compressor",
+                         getattr(getattr(sync, "inner", None),
+                                 "dc_compressor", None))
+            if isinstance(dc, PipelinedCompressor):
+                dc = dc.inner
+            if not isinstance(dc, BucketedCompressor):
+                raise ValueError(
+                    "GEOMX_FUSED_OPTIM requires the bucketed dc-tier "
+                    "engine (GEOMX_BUCKET_BYTES > 0): the kernels apply "
+                    "the update over the flat fp32 buckets")
+            fopt_bucketer = dc.zero_bucketer
+        # interpret mode off-TPU (CI, CPU meshes) — same resolution as
+        # the compression kernels' pallas_supported path.
+        # GEOMX_FUSED_OPTIM_INTERPRET overrides (=0 forces the native
+        # Mosaic lowering: bench --compare-mfu uses it to cross-lower
+        # the step for the DCE structure gate on a CPU host — such a
+        # build LOWERS anywhere but only RUNS on TPU)
+        import os as _os
+        # graftlint: disable=GXL006 — build-time gate
+        _ov = _os.environ.get("GEOMX_FUSED_OPTIM_INTERPRET")
+        if _ov is None:
+            fopt_interp = jax.default_backend() != "tpu"
+        else:
+            fopt_interp = _ov.strip().lower() not in ("0", "false", "")
+        if zplan is not None:
+            # the ZeRO shard-local update consumes the same kernels over
+            # its 1/W bucket shards (train/zero.py reads these)
+            zplan.fused_spec = fopt_spec
+            zplan.fused_interpret = fopt_interp
+
     def _zero_sync_update(grads, params, opt_state, sync_state, step):
         """ZeRO (train/zero.py): reduce-scatter compressed buckets ->
         shard-local optimizer -> all_gather params.  The optimizer (and
@@ -375,8 +474,22 @@ def build_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                 # would silently misreport)
                 if sync.grads_replicated_after_sync:
                     synced_grads = grads
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                if fopt_spec is not None:
+                    # fused apply: params and grads flatten onto the
+                    # bucket layout the dc tier already defined
+                    # (opt_state lives on the same layout —
+                    # Trainer.init_state), one Pallas pass per bucket
+                    flat_p, tdef = jax.tree.flatten(params)
+                    bk = fopt_bucketer(flat_p)
+                    new_pb, opt_state = fused_apply(
+                        fopt_spec, bk.flatten(flat_p),
+                        bk.flatten(tdef.flatten_up_to(grads)),
+                        opt_state, interpret=fopt_interp)
+                    params = tdef.unflatten(bk.unflatten(new_pb))
+                else:
+                    updates, opt_state = tx.update(grads, opt_state,
+                                                   params)
+                    params = optax.apply_updates(params, updates)
                 params, sync_state = sync.sync_params(params, sync_state,
                                                       step)
             model_state, sync_state = sync.sync_model_state(model_state,

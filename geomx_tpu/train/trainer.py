@@ -49,7 +49,39 @@ class Trainer:
         self.sync = sync if sync is not None else get_sync_algorithm(self.config)
         self.mesh = mesh if mesh is not None else topology.build_mesh()
         self.tx = optimizer
-        self.loss_fn = make_loss_fn(model.apply)
+        # compute precision (train/step.resolve_precision): under bf16
+        # the loss closure casts the normalized float inputs and the
+        # models cast their own internals per-op from the fp32 master
+        # params — nothing that accumulates ever leaves fp32, so there
+        # is no loss scaling to configure (docs/performance.md)
+        from geomx_tpu.train.step import resolve_precision
+        self._precision = resolve_precision(self.config)
+        compute_dtype = jnp.bfloat16 if self._precision == "bf16" else None
+        self.loss_fn = make_loss_fn(model.apply,
+                                    compute_dtype=compute_dtype)
+        if self._precision == "bf16":
+            mdt = getattr(model, "dtype", None)
+            if mdt is None or mdt == jnp.float32:
+                import warnings
+                # the input cast alone buys nothing if the model's
+                # layers immediately promote back to fp32
+                warnings.warn(
+                    "GEOMX_PRECISION=bf16 but the model's compute dtype "
+                    f"is {mdt!r}: its layers will promote back to fp32. "
+                    "Build the model with a bf16 dtype (e.g. "
+                    "get_model(name, precision='bf16')) to realize the "
+                    "mixed-precision speedup", stacklevel=2)
+        # fused optimizer apply (ops/optim_pallas.py): resolved here so
+        # init_state allocates optimizer state on the bucket layout the
+        # fused path updates; build_train_step re-checks the gate and
+        # validates the optimizer/compressor stack
+        from geomx_tpu.ops.optim_pallas import fused_optim_enabled
+        self._fused_optim = fused_optim_enabled(self.config)
+        # input-pipeline overlap depth (data/loader.py): how many
+        # assembled+device_put batches the producer thread keeps in
+        # flight ahead of the step; 0 = synchronous (the host_stall
+        # baseline bench.py --compare-mfu measures against)
+        self._prefetch = max(0, int(getattr(self.config, "prefetch", 2)))
         sp_model = getattr(model, "sp_mode", None) is not None
         if getattr(topology, "sp_degree", 1) > 1 and not sp_model:
             import warnings
@@ -238,6 +270,29 @@ class Trainer:
             shards = self._zero_plan.shard_example(
                 params, self._zero_plan.bucketed)
             opt_state = self.tx.init(shards)
+            sync_state = self.sync.init_state(params,
+                                              model_state=model_state)
+        elif self._fused_optim:
+            # fused apply: the optimizer state lives on the flat bucket
+            # layout (one fp32 vector per bucket, lane-padded sizes) —
+            # the same layout the dc tier already fuses gradients onto,
+            # so the kernels update params, moments and wire buckets in
+            # one coordinate system
+            from geomx_tpu.compression.bucketing import BucketedCompressor
+            from geomx_tpu.sync.pipeline import PipelinedCompressor
+            dc = getattr(self.sync, "dc_compressor",
+                         getattr(getattr(self.sync, "inner", None),
+                                 "dc_compressor", None))
+            if isinstance(dc, PipelinedCompressor):
+                dc = dc.inner
+            if not isinstance(dc, BucketedCompressor):
+                raise ValueError(
+                    "GEOMX_FUSED_OPTIM requires the bucketed dc-tier "
+                    "engine (GEOMX_BUCKET_BYTES > 0): the kernels apply "
+                    "the update over the flat fp32 buckets")
+            bk = dc.zero_bucketer(jax.tree.leaves(params))
+            opt_state = self.tx.init(
+                [jnp.zeros((n,), jnp.float32) for n in bk.bucket_sizes])
             sync_state = self.sync.init_state(params,
                                               model_state=model_state)
         else:
@@ -1182,7 +1237,7 @@ class Trainer:
         # the CPU backend (and any blocking sync_every boundary) is the
         # regime where it is the real step.
         for epoch in range(epochs):
-            for xb, yb in loader.epoch(epoch):
+            for xb, yb in loader.epoch(epoch, prefetch=self._prefetch):
                 # arm the auditor on the first batch (abstract trace of
                 # the active program; no-op unless GEOMX_AUDIT is on)
                 self._audit_capture(state, xb, yb)
@@ -1194,16 +1249,27 @@ class Trainer:
                                 args={"step": it}):
                     with prof.scope("train/compute", "compute"):
                         state, metrics = self.train_step(state, xb, yb)
-                it += 1
+                        it += 1
+                        # the log/sync boundary wait is device compute
+                        # (on the CPU backend the whole step; on an
+                        # accelerator the async-dispatch catch-up), so
+                        # it stays inside the compute span — attributed
+                        # host_stall is then genuinely the input
+                        # pipeline and dispatch gaps, which is what the
+                        # GEOMX_PREFETCH acceptance (bench.py
+                        # --compare-mfu) measures
+                        synced = None
+                        if log_every and it % log_every == 0:
+                            synced = jax.device_get(metrics)
+                        elif it % sync_every == 0:
+                            jax.block_until_ready(metrics["loss"])
                 fields = {}
-                if log_every and it % log_every == 0:
-                    metrics = jax.device_get(metrics)
+                if synced is not None:
+                    metrics = synced
                     fields.update(loss=float(metrics["loss"]),
                                   train_acc=float(metrics["accuracy"]))
                     if self._telemetry and "telemetry" in metrics:
                         self._publish_telemetry(metrics["telemetry"], it)
-                elif it % sync_every == 0:
-                    jax.block_until_ready(metrics["loss"])
                 if eval_data is not None and eval_every and it % eval_every == 0:
                     fields["test_acc"] = self.evaluate(state, *eval_data)
                 if fields:

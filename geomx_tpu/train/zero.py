@@ -73,6 +73,11 @@ class ZeroPlan:
         self.W = int(workers_per_party)
         self.lane = int(lane)
         self.bucketed: "BucketedCompressor | None" = None  # bind_compressor
+        # set by build_train_step under GEOMX_FUSED_OPTIM: the static
+        # spec routes apply_shard_update through the fused Pallas
+        # kernels (ops/optim_pallas.py) over the same bucket shards
+        self.fused_spec = None
+        self.fused_interpret = False
 
     @property
     def pad_to(self) -> int:
@@ -156,8 +161,18 @@ class ZeroPlan:
         bk = self.bucketed.zero_bucketer(flat_p)
         widx = lax.axis_index(axis_name)
         p_shards = [self.slice_shard(b, widx) for b in bk.flatten(flat_p)]
-        updates, opt_state = tx.update(shard_g, opt_state, p_shards)
-        new_shards = optax.apply_updates(p_shards, updates)
+        if self.fused_spec is not None:
+            # fused apply (ops/optim_pallas.py): the kernels are shape-
+            # agnostic over flat fp32 vectors, so the 1/W bucket shards
+            # go through unchanged — the shard-local update and the
+            # replicated one share one kernel
+            from geomx_tpu.ops.optim_pallas import fused_apply
+            new_shards, opt_state = fused_apply(
+                self.fused_spec, p_shards, shard_g, opt_state,
+                interpret=self.fused_interpret)
+        else:
+            updates, opt_state = tx.update(shard_g, opt_state, p_shards)
+            new_shards = optax.apply_updates(p_shards, updates)
         full = [self.gather_bucket(sh, axis_name) for sh in new_shards]
         return treedef.unflatten(bk.unflatten(full)), opt_state
 

@@ -1,0 +1,149 @@
+"""Backend-selection hygiene (runtime/backends.py, GEOMX_SCRUB_PLATFORMS).
+
+BENCH_r05 published 0.0 after burning 2x480s inside the experimental
+'axon' plugin's platform probe.  The scrub removes blocklisted plugins
+from JAX's selection order before the first backend initializes; the
+bench parent injects it into the retry env after an init-timeout so a
+wedged probe costs one attempt, not the run.  Pinned here:
+
+- the GEOMX_SCRUB_PLATFORMS grammar (off by default — axon is also the
+  real TPU tunnel);
+- an explicit JAX_PLATFORMS naming a scrubbed platform wins;
+- scrubbing is a no-op when nothing registered matches;
+- a matching registration is dropped from the jax_platforms order with
+  cpu sorted last;
+- the end-to-end regression: a wedged init under
+  GEOMX_BENCH_FAULT_HANG_INIT makes the parent's retry inject
+  GEOMX_SCRUB_PLATFORMS=1 (recorded in the published attempt log),
+  and a user-set value is never overridden.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from geomx_tpu.runtime.backends import (DEFAULT_SCRUB, registered_platforms,
+                                        scrub_list, scrub_platforms)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# grammar
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("raw", [None, "0", "none", "off", "false", "", " "])
+def test_scrub_list_disabled_forms(raw):
+    env = {} if raw is None else {"GEOMX_SCRUB_PLATFORMS": raw}
+    assert scrub_list(env) == ()
+
+
+@pytest.mark.parametrize("raw", ["1", "default", "on", "true", "DEFAULT"])
+def test_scrub_list_default_forms(raw):
+    assert scrub_list({"GEOMX_SCRUB_PLATFORMS": raw}) == DEFAULT_SCRUB
+
+
+def test_scrub_list_explicit_names():
+    env = {"GEOMX_SCRUB_PLATFORMS": " Axon , fooTPU "}
+    assert scrub_list(env) == ("axon", "footpu")
+
+
+# --------------------------------------------------------------------------
+# scrub_platforms semantics (never touches the real cpu registration)
+# --------------------------------------------------------------------------
+
+def test_scrub_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("GEOMX_SCRUB_PLATFORMS", raising=False)
+    assert scrub_platforms() == ()
+
+
+def test_scrub_noop_when_nothing_registered_matches(monkeypatch):
+    # 'axon' is not registered in this CPU-only test process
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert "axon" not in {p.lower() for p in registered_platforms()}
+    assert scrub_platforms(scrub=("axon",)) == ()
+
+
+def test_explicit_jax_platforms_wins(monkeypatch):
+    """The user asked for the platform by name: the scrub must yield
+    even when the name is registered."""
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    from jax._src import xla_bridge
+    monkeypatch.setitem(xla_bridge._backend_factories, "axon",
+                        lambda: None)
+    assert scrub_platforms(scrub=("axon",)) == ()
+
+
+def test_scrub_drops_registration_and_pins_order(monkeypatch):
+    """A registered blocklisted platform is removed from the selection
+    order (jax_platforms pinned to the survivors, cpu last)."""
+    import jax
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    from jax._src import xla_bridge
+    monkeypatch.setitem(xla_bridge._backend_factories, "axon",
+                        lambda: None)
+    before = jax.config.jax_platforms
+    try:
+        hit = scrub_platforms(scrub=("axon",))
+        assert hit == ("axon",)
+        order = jax.config.jax_platforms.split(",")
+        assert "axon" not in order
+        assert order[-1] == "cpu"
+    finally:
+        jax.config.update("jax_platforms", before)
+
+
+# --------------------------------------------------------------------------
+# end-to-end regression: the retry env injection after a wedged init
+# --------------------------------------------------------------------------
+
+def _wedged_bench_record(extra_env):
+    env = dict(os.environ)
+    env.update({
+        "GEOMX_BENCH_PLATFORM": "cpu",
+        "GEOMX_BENCH_INIT_TIMEOUT": "4",
+        "GEOMX_BENCH_INIT_ATTEMPTS": "2",
+        "GEOMX_BENCH_CPU_FALLBACK": "0",
+        "GEOMX_BENCH_RESUME_ATTEMPTS": "0",
+        # wedge the child right after its first phase mark, before the
+        # jax import, so both attempts bound at ~4s each
+        "GEOMX_BENCH_FAULT_HANG_INIT": "120",
+    })
+    env.pop("XLA_FLAGS", None)
+    env.pop("GEOMX_SCRUB_PLATFORMS", None)
+    env.update(extra_env)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")], cwd=REPO,
+        env=env, capture_output=True, text=True, timeout=120)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, out.stderr[-2000:]
+    return json.loads(lines[-1])
+
+
+def test_retry_injects_scrub_after_init_wedge():
+    """The BENCH_r05 fix end-to-end: attempt 1 probes everything and
+    wedges; the parent's retry env carries GEOMX_SCRUB_PLATFORMS so the
+    respawn skips the wedged plugin probe instead of re-burning the
+    budget on the identical hang."""
+    rec = _wedged_bench_record({})
+    attempts = rec["init_attempts"]
+    assert len(attempts) == 2
+    assert attempts[0]["init_ok"] is False
+    assert "retry_env" not in attempts[0]
+    assert "GEOMX_SCRUB_PLATFORMS" in attempts[1]["retry_env"]
+    # the cache/flags scrub from the original retry policy still rides
+    assert "GEOMX_COMPILE_CACHE" in attempts[1]["retry_env"]
+
+
+def test_retry_never_overrides_user_scrub_setting():
+    """A user-set GEOMX_SCRUB_PLATFORMS (including =0) is authoritative:
+    the retry keeps the cache/flags scrub but does not inject its own
+    platform scrub over the user's choice."""
+    rec = _wedged_bench_record({"GEOMX_SCRUB_PLATFORMS": "0"})
+    attempts = rec["init_attempts"]
+    assert len(attempts) == 2
+    assert "GEOMX_SCRUB_PLATFORMS" not in attempts[1]["retry_env"]
+    assert "GEOMX_COMPILE_CACHE" in attempts[1]["retry_env"]

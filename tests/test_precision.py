@@ -1,0 +1,180 @@
+"""bf16 mixed-precision as a first-class mode (GEOMX_PRECISION).
+
+The contract (train/step.py resolve_precision, docs/performance.md):
+fp32 master weights + bf16 compute — the model casts per-op from the
+fp32 masters, activations/matmuls run bf16, the classifier head / loss
+/ gradients / optimizer state stay fp32.  No loss scaling exists
+anywhere because nothing that accumulates ever leaves fp32 and bf16
+shares fp32's exponent range.
+
+Evidence layers:
+
+- *Resolution*: config wins over env, aliases normalize, junk rejects.
+- *Masters stay fp32*: a bf16-precision build's params and optimizer
+  state are fp32; logits come back fp32.
+- *Trajectory parity*: the bf16 build tracks the fp32 trajectory across
+  FSA / MixedSync / Pipelined / ZeRO on the 8-device mesh within the
+  documented tolerance (it is the SAME math at lower mantissa, not a
+  different algorithm).
+- *Audit teeth* (GX-DTYPE-001, analysis/passes.py audit_precision): a
+  legitimately-built bf16 model audits clean with the head exemption,
+  an fp32 model declared bf16 is flagged per heavy op, and fp32
+  declarations are vacuously clean.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from geomx_tpu.analysis.passes import audit_precision
+from geomx_tpu.config import GeoConfig
+from geomx_tpu.models import get_model
+from geomx_tpu.sync import get_sync_algorithm
+from geomx_tpu.topology import HiPSTopology
+from geomx_tpu.train import Trainer
+from geomx_tpu.train.step import resolve_precision
+
+P_, W_, STEPS = 2, 4, 4
+
+
+# --------------------------------------------------------------------------
+# resolution
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("raw,want", [
+    ("fp32", "fp32"), ("float32", "fp32"), ("f32", "fp32"),
+    ("bf16", "bf16"), ("bfloat16", "bf16"), ("BF16", "bf16")])
+def test_resolve_aliases(raw, want):
+    assert resolve_precision(GeoConfig(precision=raw)) == want
+
+
+def test_resolve_env_and_default(monkeypatch):
+    monkeypatch.delenv("GEOMX_PRECISION", raising=False)
+    assert resolve_precision() == "fp32"
+    monkeypatch.setenv("GEOMX_PRECISION", "bf16")
+    assert resolve_precision() == "bf16"
+    # the config wins over the environment
+    assert resolve_precision(GeoConfig(precision="fp32")) == "fp32"
+
+
+def test_resolve_rejects_junk():
+    with pytest.raises(ValueError, match="unknown precision"):
+        resolve_precision(GeoConfig(precision="fp16"))
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("GEOMX_PRECISION", "bf16")
+    monkeypatch.setenv("GEOMX_FUSED_OPTIM", "1")
+    monkeypatch.setenv("GEOMX_PREFETCH", "4")
+    cfg = GeoConfig.from_env()
+    assert cfg.precision == "bf16"
+    assert cfg.fused_optim is True
+    assert cfg.prefetch == 4
+
+
+# --------------------------------------------------------------------------
+# masters stay fp32
+# --------------------------------------------------------------------------
+
+def test_bf16_masters_and_logits_fp32():
+    model = get_model("cnn", num_classes=10, precision="bf16")
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    vs = jax.jit(lambda r: model.init(r, x, train=False))(
+        jax.random.PRNGKey(0))
+    for leaf in jax.tree.leaves(vs["params"]):
+        assert leaf.dtype == jnp.float32
+    logits = model.apply(vs, x, train=False)
+    assert logits.dtype == jnp.float32
+
+
+# --------------------------------------------------------------------------
+# trajectory parity across the sync algorithms
+# --------------------------------------------------------------------------
+
+def _run(precision, **over):
+    topo = HiPSTopology(num_parties=P_, workers_per_party=W_)
+    cfg = GeoConfig(num_parties=P_, workers_per_party=W_,
+                    precision=precision, **over)
+    tr = Trainer(get_model("cnn", num_classes=10, precision=precision),
+                 topo, optax.sgd(0.1, momentum=0.9),
+                 sync=get_sync_algorithm(cfg), config=cfg)
+    rng = np.random.RandomState(0)
+    xs = (rng.rand(STEPS, P_, W_, 2, 32, 32, 3) * 255).astype(np.uint8)
+    ys = rng.randint(0, 10, size=(STEPS, P_, W_, 2)).astype(np.int32)
+    st = tr.init_state(jax.random.PRNGKey(0), xs[0, 0, 0, :2])
+    sh = topo.batch_sharding(tr.mesh)
+    losses = []
+    for s in range(STEPS):
+        st, m = tr.train_step(st, jax.device_put(xs[s], sh),
+                              jax.device_put(ys[s], sh))
+        losses.append(float(m["loss"]))
+    jax.block_until_ready(st.step)
+    params = jax.tree.map(lambda a: np.asarray(a, np.float64)[0, 0],
+                          st.params)
+    return losses, params
+
+
+@pytest.mark.parametrize("over", [
+    {},                                                   # FSA
+    {"sync_mode": "mixed"},                               # MixedSync
+    {"pipeline_depth": 1},                                # Pipelined
+    {"zero": 1, "bucket_bytes": 1 << 18},                 # ZeRO
+], ids=["fsa", "mixed", "pipelined", "zero"])
+def test_bf16_tracks_fp32(over):
+    l32, p32 = _run("fp32", **over)
+    l16, p16 = _run("bf16", **over)
+    # same math at bf16 mantissa: the loss curves stay on top of each
+    # other and params drift only by accumulated rounding
+    assert max(abs(a - b) for a, b in zip(l32, l16)) < 0.05
+    gap = max(jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.max(np.abs(a - b))), p32, p16)))
+    assert gap < 0.05, gap
+
+
+def test_bf16_optimizer_state_fp32():
+    topo = HiPSTopology(num_parties=P_, workers_per_party=W_)
+    cfg = GeoConfig(num_parties=P_, workers_per_party=W_,
+                    precision="bf16")
+    tr = Trainer(get_model("cnn", num_classes=10, precision="bf16"),
+                 topo, optax.sgd(0.1, momentum=0.9),
+                 sync=get_sync_algorithm(cfg), config=cfg)
+    st = tr.init_state(jax.random.PRNGKey(0),
+                       np.zeros((2, 32, 32, 3), np.uint8))
+    for leaf in jax.tree.leaves(st.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            assert leaf.dtype == jnp.float32
+
+
+# --------------------------------------------------------------------------
+# audit teeth
+# --------------------------------------------------------------------------
+
+def _forward(precision):
+    mdl = get_model("cnn", num_classes=10, precision=precision)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    vs = jax.eval_shape(lambda: mdl.init(jax.random.PRNGKey(0), x,
+                                         train=False))
+    vs = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), vs)
+    return (lambda xx: mdl.apply(vs, xx, train=False)), x
+
+
+def test_audit_clean_on_bf16_model():
+    fn, x = _forward("bf16")
+    assert audit_precision(fn, x, precision="bf16",
+                           allowed_fp32_sites=1) == []
+
+
+def test_audit_flags_fp32_model_declared_bf16():
+    fn, x = _forward("fp32")
+    findings = audit_precision(fn, x, precision="bf16",
+                               allowed_fp32_sites=1)
+    assert findings
+    assert all(f.rule_id == "GX-DTYPE-001" for f in findings)
+
+
+def test_audit_fp32_declaration_vacuous():
+    fn, x = _forward("fp32")
+    assert audit_precision(fn, x, precision="fp32") == []

@@ -487,6 +487,37 @@ def test_disabled_telemetry_jaxpr_is_byte_identical(monkeypatch):
     assert j_off == j_base
 
 
+def test_compute_engine_defaults_keep_jaxpr_byte_identical(monkeypatch):
+    """Re-pin of the overhead guarantee after the compute-phase engine
+    (GEOMX_PRECISION / GEOMX_FUSED_OPTIM / GEOMX_PREFETCH): with every
+    new knob at its default — explicitly spelled out OR resolved from a
+    clean environment — the telemetry-disabled step traces byte-identical
+    to the historical build.  The engine is static-gated at build time,
+    never a traced branch."""
+    for var in ("GEOMX_TELEMETRY", "GEOMX_PRECISION", "GEOMX_FUSED_OPTIM",
+                "GEOMX_PREFETCH"):
+        monkeypatch.delenv(var, raising=False)
+    x, y = _mini_batch()
+    tr = _mini_trainer(False)
+    state = tr.init_state(jax.random.PRNGKey(0), x[0, 0, :2])
+    sharding = tr.topology.batch_sharding(tr.mesh)
+    xb, yb = jax.device_put(x, sharding), jax.device_put(y, sharding)
+    j_base = canonicalize_jaxpr(
+        str(jax.make_jaxpr(tr.train_step)(state, xb, yb)))
+
+    topo = HiPSTopology(num_parties=2, workers_per_party=1)
+    cfg = GeoConfig(num_parties=2, workers_per_party=1,
+                    compression="bsc,0.05,min_sparse_size=16",
+                    telemetry=False, precision="fp32",
+                    fused_optim=False, prefetch=2)
+    tr2 = Trainer(MLP(num_classes=10, hidden=(32,)), topo,
+                  optax.sgd(0.1), sync=get_sync_algorithm(cfg),
+                  config=cfg, donate=False)
+    j_explicit = canonicalize_jaxpr(
+        str(jax.make_jaxpr(tr2.train_step)(state, xb, yb)))
+    assert j_explicit == j_base
+
+
 def test_enabled_probes_report_step_health(tmp_path):
     events = str(tmp_path / "events.jsonl")
     x, y = _mini_batch()

@@ -23,7 +23,10 @@ booleans plus the chaos-free p50/p99 round latency, lower is
 better), and ``CAPSULE_r*.json`` (the ``--compare-capsule`` run-capsule
 acceptance: capture / replay-fidelity / cost-model-accuracy booleans
 plus the cost model's max per-config relative error, lower is
-better).
+better), and ``TRANSFORMER_r*.json`` (the ``--compare-mfu``
+compute-phase-engine acceptance: fused-optimizer DCE / bf16-parity /
+prefetch booleans plus both workloads' roofline MFU, higher is
+better, and the prefetch-on host_stall fraction, lower is better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
 until a human re-read the numbers.  This tool makes the trajectory a
@@ -78,6 +81,7 @@ DIRECTION = {
     "honesty_ratio_max": "down",
     "merge_speedup": "up",
     "cost_model_max_rel_err": "down",
+    "host_stall_fraction": "down",
 }
 
 
@@ -225,6 +229,42 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
             out["cost_model_max_rel_err"] = \
                 float(rec["cost_model_max_rel_err"])
         return out
+    if rec.get("mode") == "compare_mfu":    # TRANSFORMER_r*
+        for gate in ("ok", "per_leaf_chain_gone", "params_match",
+                     "bf16_matches_fp32", "host_stall_drops",
+                     "phase_sum_ok"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        pre = rec.get("precision")
+        if isinstance(pre, dict):
+            for gate in ("dtype_audit_clean", "fp32_leak_detected"):
+                if gate in pre:
+                    out[gate] = bool(pre[gate])
+        pf = rec.get("prefetch")
+        if isinstance(pf, dict):
+            if "prefetch_deterministic" in pf:
+                out["prefetch_deterministic"] = bool(
+                    pf["prefetch_deterministic"])
+            if isinstance(pf.get("host_stall_fraction_on"),
+                          (int, float)):
+                # the prefetch-on residual stall; lower is better —
+                # machine-sensitive (host core count), the band still
+                # catches the overlap collapsing back to synchronous
+                out["host_stall_fraction"] = float(
+                    pf["host_stall_fraction_on"])
+        roof = rec.get("roofline")
+        if isinstance(roof, dict):
+            for wname, wrec in sorted(roof.items()):
+                if not isinstance(wrec, dict):
+                    continue
+                for k in ("mfu", "samples_per_sec", "step_time_ms"):
+                    v = wrec.get(k)
+                    if isinstance(v, (int, float)):
+                        out[f"roofline.{wname}.{k}"] = float(v)
+        dev = rec.get("device") or {}
+        if isinstance(dev, dict) and dev.get("device_kind"):
+            out["device_kind"] = dev["device_kind"]
+        return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
                      "decision_log_deterministic",
@@ -364,7 +404,7 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
                             "MULTICHIP_r*.json", "CONTROL_r*.json",
                             "RECOVERY_r*.json", "MANYPARTY_r*.json",
                             "SPARSEAGG_r*.json", "FLEETOBS_r*.json",
-                            "CAPSULE_r*.json"]
+                            "CAPSULE_r*.json", "TRANSFORMER_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     raw_docs: Dict[str, List[dict]] = {}
     unreadable: List[str] = []
