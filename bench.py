@@ -6121,6 +6121,378 @@ def compare_mfu_main(argv):
     _emit(_compare_mfu(**kwargs))
 
 
+# --------------------------------------------------------------------------
+# --serve: geo-distributed serving plane acceptance (docs/serving.md) —
+# sparse-delta model registry + continuous-batching inference gateway.
+# Three phases: (A) sustained gateway QPS with p50/p99 at the target
+# batch and a bounded jit cache; (B) train-while-serving — dense base
+# published once, then delta-only pair-format refresh rounds with the
+# replica reconstructing bit-exact vs a dense checkpoint and delta-only
+# verified via round-ledger byte accounting; (C) chaos — registry shard
+# kill mid-refresh + failover restart on the same journal, replayed
+# pushes absorbed by the (layer,round)/(sender,rid) dedup, serving p99
+# bounded and ZERO lost requests throughout.
+# --------------------------------------------------------------------------
+
+
+def _serve_http_load(port, xs, n_requests, clients, rows_per_req,
+                     stop_evt=None, deadline_s=30.0):
+    """Fire ``n_requests`` POST /infer calls from ``clients`` threads
+    (or run until ``stop_evt`` when n_requests is None).  Every request
+    is accounted: ok (2xx), shed (503) or error — the zero-lost gate is
+    issued == ok + shed + error."""
+    import math
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    lock = threading.Lock()
+    stats = {"issued": 0, "ok": 0, "shed": 0, "error": 0,
+             "latencies_s": [], "batch_sizes": []}
+    url = f"http://127.0.0.1:{port}/infer"
+
+    def one_request(rng):
+        rows = [xs[rng.integers(0, len(xs))].tolist()
+                for _ in range(rows_per_req)]
+        body = json.dumps({"inputs": rows}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(req, timeout=deadline_s) as r:
+                doc = json.loads(r.read())
+                dt = time.time() - t0
+                with lock:
+                    stats["ok"] += 1
+                    stats["latencies_s"].append(dt)
+                    stats["batch_sizes"].extend(doc.get("batch_sizes", []))
+        except urllib.error.HTTPError as e:
+            e.read()
+            with lock:
+                stats["shed" if e.code == 503 else "error"] += 1
+                stats["latencies_s"].append(time.time() - t0)
+        except Exception:
+            with lock:
+                stats["error"] += 1
+
+    def worker(wid):
+        rng = np.random.default_rng(1000 + wid)
+        while True:
+            with lock:
+                if n_requests is not None and stats["issued"] >= n_requests:
+                    return
+                if stop_evt is not None and stop_evt.is_set():
+                    return
+                stats["issued"] += 1
+            one_request(rng)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(deadline_s * 4)
+    stats["elapsed_s"] = time.time() - t0
+    lat = sorted(stats["latencies_s"])
+
+    def pct(q):
+        if not lat:
+            return None
+        return lat[min(len(lat) - 1, int(math.ceil(q * len(lat))) - 1)]
+
+    stats["p50_s"], stats["p99_s"] = pct(0.50), pct(0.99)
+    stats["qps"] = (stats["ok"] / stats["elapsed_s"]
+                    if stats["elapsed_s"] > 0 else 0.0)
+    del stats["latencies_s"]
+    return stats
+
+
+def _compare_serve(rounds: int = 5, qps_requests: int = 120,
+                   clients: int = 4, rows_per_req: int = 2,
+                   max_batch: int = 8, queue_ms: float = 2.0,
+                   delta_frac: float = 0.01, seed: int = 0,
+                   out_dir=None):
+    import jax
+    import numpy as np
+
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.serve.gateway import (InferenceGateway, flatten_params)
+    from geomx_tpu.serve.registry import RegistryClient, RegistryServer
+    from geomx_tpu.serve.replica import ServingReplica
+    from geomx_tpu.telemetry.ledger import (get_round_ledger,
+                                            reset_request_ledger,
+                                            reset_round_ledger)
+
+    cfg = GeoConfig.from_env()
+    rng = np.random.default_rng(seed)
+    t_bench0 = time.time()
+    out = {"mode": "compare_serve", "rounds": rounds,
+           "max_batch": max_batch, "queue_ms": queue_ms,
+           "staleness_budget_s": cfg.serve_staleness_s}
+
+    reset_round_ledger()
+    reset_request_ledger()
+
+    # ---- model + registry publish (dense base, once) --------------------
+    model = get_model("mlp", num_classes=10)
+    feat = 28 * 28
+    x0 = np.zeros((1, feat), np.float32)
+    variables = model.init(jax.random.PRNGKey(seed), x0)
+    named, treedef = flatten_params(variables)
+    named = {k: np.ascontiguousarray(v, np.float32)
+             for k, v in named.items()}
+    dense_ckpt = {k: v.copy() for k, v in named.items()}
+    dense_bytes = int(sum(v.nbytes for v in named.values()))
+    out["model"] = {"name": "mlp", "layers": len(named),
+                    "dense_bytes": dense_bytes}
+
+    durable_dir = tempfile.mkdtemp(prefix="geomx_serve_registry_")
+    srv = RegistryServer(durable_dir=durable_dir)
+    srv.start()
+    trainer = RegistryClient(srv.addr, sender=0, timeout_s=20.0)
+    trainer.publish("v1", named)
+
+    replica_cli = RegistryClient(srv.addr, sender=1, timeout_s=20.0)
+    replica = ServingReplica("v1", party=1)
+    first = replica.sync(replica_cli)
+    out["base_sync"] = first
+
+    gw = InferenceGateway(replica, treedef=treedef, model_name="mlp",
+                          num_classes=10, max_batch=max_batch,
+                          queue_ms=queue_ms)
+    gw.start()
+    httpd = gw.serve_http(port=cfg.serve_port)
+    port = httpd.server_address[1]
+    xs = rng.normal(size=(16, feat)).astype(np.float32)
+
+    try:
+        # ---- phase A: sustained QPS at the target batch -----------------
+        _serve_http_load(port, xs, 8, 2, rows_per_req)  # jit warmup
+        reset_request_ledger()
+        load = _serve_http_load(port, xs, qps_requests, clients,
+                                rows_per_req)
+        out["qps_phase"] = load
+        out["serve_qps"] = round(load["qps"], 2)
+        out["serve_p50_ms"] = round(1e3 * (load["p50_s"] or 0.0), 3)
+        out["serve_p99_ms"] = round(1e3 * (load["p99_s"] or 0.0), 3)
+        out["batch_max_seen"] = int(max(load["batch_sizes"] or [0]))
+        out["jit_cache_size"] = gw.jit_cache_size()
+        out["jit_cache_bounded"] = bool(
+            gw.jit_cache_size() <= len(gw.buckets))
+        out["batch_bounded"] = bool(out["batch_max_seen"] <= max_batch)
+
+        # ---- phase B: train-while-serving, delta-only refresh ----------
+        stop_evt = threading.Event()
+        bg_stats = {}
+
+        def bg_load():
+            bg_stats.update(_serve_http_load(
+                port, xs, None, 2, rows_per_req, stop_evt=stop_evt))
+
+        bg = threading.Thread(target=bg_load, daemon=True)
+        bg.start()
+        max_staleness = 0.0
+        for r in range(1, rounds + 1):
+            layers = {}
+            for k, v in dense_ckpt.items():
+                n = v.size
+                kk = max(1, int(n * delta_frac))
+                idx = rng.choice(n, size=kk, replace=False).astype(np.int64)
+                vals = rng.normal(size=kk).astype(np.float32) * 0.01
+                layers[k] = (vals, idx)
+                np.add.at(v.reshape(-1), idx, vals)
+            ack = trainer.push_delta("v1", r, layers)
+            if ack["applied_layers"] != len(layers):
+                raise RuntimeError(f"round {r} push under-applied: {ack}")
+            replica.sync(replica_cli)
+            max_staleness = max(max_staleness, replica.staleness_s())
+        stop_evt.set()
+        bg.join(30.0)
+        out["train_while_serving"] = {
+            "bg_requests": bg_stats.get("issued", 0),
+            "bg_ok": bg_stats.get("ok", 0),
+            "bg_shed": bg_stats.get("shed", 0),
+            "bg_error": bg_stats.get("error", 0),
+            "max_staleness_s": round(max_staleness, 3),
+        }
+        out["staleness_bounded"] = bool(
+            max_staleness <= cfg.serve_staleness_s)
+
+        served = replica.params()
+        bit_exact = all(
+            np.array_equal(served[k], dense_ckpt[k]) for k in dense_ckpt)
+        out["bit_exact"] = bool(bit_exact)
+
+        # delta-only, verified via round-ledger byte accounting: the
+        # registry wire frames carry meta["round"] + wire_declared, so
+        # the protocol choke point attributed every byte.  Post-base
+        # refresh must be pair frames a fraction of the dense size.
+        base_rx = delta_rx = 0
+        declared_honest = True
+        for rec in get_round_ledger().records():
+            if not str(rec.get("key", "")).startswith("v1/"):
+                continue
+            wire = rec.get("wire", {})
+            got = int(wire.get("push_rx_bytes", 0))
+            if int(rec.get("round", -1)) == 0:
+                base_rx += got
+            else:
+                delta_rx += got
+                declared = int(rec.get("declared_rx_bytes", 0) or 0)
+                if declared <= 0 or declared > got:
+                    declared_honest = False
+        per_round = delta_rx / max(1, rounds)
+        out["ledger_bytes"] = {
+            "base_push_rx": base_rx, "delta_push_rx": delta_rx,
+            "delta_per_round": round(per_round, 1),
+            "declared_honest": declared_honest,
+        }
+        out["delta_only"] = bool(
+            base_rx > 0 and delta_rx > 0 and declared_honest
+            and per_round < 0.5 * dense_bytes)
+
+        # ---- phase C: chaos — registry kill mid-refresh + failover -----
+        reset_request_ledger()
+        stop_evt2 = threading.Event()
+        chaos_stats = {}
+
+        def chaos_load():
+            chaos_stats.update(_serve_http_load(
+                port, xs, None, 2, rows_per_req, stop_evt=stop_evt2))
+
+        bg2 = threading.Thread(target=chaos_load, daemon=True)
+        bg2.start()
+
+        chaos_round = rounds + 1
+        layers = {}
+        for k, v in dense_ckpt.items():
+            kk = max(1, int(v.size * delta_frac))
+            idx = rng.choice(v.size, size=kk, replace=False).astype(np.int64)
+            vals = rng.normal(size=kk).astype(np.float32) * 0.01
+            layers[k] = (vals, idx)
+            np.add.at(v.reshape(-1), idx, vals)
+        # half the layers land, then the registry dies mid-refresh
+        names = list(layers)
+        half = {k: layers[k] for k in names[:max(1, len(names) // 2)]}
+        trainer.push_delta("v1", chaos_round, half)
+        srv.crash()
+        gen_old = srv.generation
+
+        failover = RegistryServer(durable_dir=durable_dir)
+        failover.start()
+        out["failover_generation"] = {"old": gen_old,
+                                      "new": failover.generation}
+        trainer2 = RegistryClient(failover.addr, sender=0, timeout_s=20.0)
+        # replay the WHOLE round against the failover: the half that
+        # already landed must dedup ((layer, round) journaled), only the
+        # torn-off remainder applies — the no-double-apply gate
+        ack = trainer2.push_delta("v1", chaos_round, layers)
+        expected_new = len(layers) - len(half)
+        out["chaos_replay"] = {
+            "layers": len(layers), "pre_crash": len(half),
+            "replay_applied": int(ack["applied_layers"]),
+        }
+        no_double_apply = ack["applied_layers"] == expected_new
+
+        replica_cli2 = RegistryClient(failover.addr, sender=1,
+                                      timeout_s=20.0)
+        post = replica.sync(replica_cli2)
+        out["chaos_sync"] = post
+        served = replica.params()
+        chaos_bit_exact = all(
+            np.array_equal(served[k], dense_ckpt[k]) for k in dense_ckpt)
+        no_double_apply = no_double_apply and chaos_bit_exact
+
+        stop_evt2.set()
+        bg2.join(30.0)
+        out["chaos_load"] = chaos_stats
+        lost = (chaos_stats.get("issued", 0)
+                - chaos_stats.get("ok", 0) - chaos_stats.get("shed", 0)
+                - chaos_stats.get("error", 0))
+        out["zero_lost"] = bool(
+            lost == 0 and chaos_stats.get("error", 0) == 0
+            and chaos_stats.get("issued", 0) > 0)
+        chaos_p99 = chaos_stats.get("p99_s") or 0.0
+        out["chaos_p99_ms"] = round(1e3 * chaos_p99, 3)
+        out["chaos_p99_bounded"] = bool(0.0 < chaos_p99 < 2.0)
+        out["no_double_apply"] = bool(no_double_apply)
+        out["restart_detected"] = bool(post.get("restart_detected"))
+        out["replica"] = replica.snapshot()
+
+        # ---- SLO policy sanity: the pilot's fourth family fires --------
+        from geomx_tpu.control.policy import SloPolicy
+        from geomx_tpu.control.sensors import ControlObservation
+        pol = SloPolicy(lambda: {"p99_s": 10.0}, target_p99_s=0.5,
+                        confirm=1, cooldown=1)
+        obs = ControlObservation(step=1, links={}, exposed_comms=0.0,
+                                 hidden_comms=0.0, compute_s=0.0,
+                                 ef_residual_norm=0.0, grad_norm=0.0,
+                                 dc_dense_bytes=0)
+        d = pol.decide(obs)
+        out["slo_shed_decision"] = bool(
+            d is not None and d.value[0] == "shed" and d.value[1] > 0)
+
+        trainer2.close()
+        replica_cli2.close()
+        failover.stop()
+        failover.join(5.0)
+    finally:
+        httpd.shutdown()
+        gw.stop()
+        trainer.close()
+        replica_cli.close()
+        srv.stop()
+        srv.join(5.0)
+
+    out["elapsed_s"] = round(time.time() - t_bench0, 3)
+    out["ok"] = bool(
+        out.get("bit_exact") and out.get("delta_only")
+        and out.get("staleness_bounded") and out.get("zero_lost")
+        and out.get("chaos_p99_bounded") and out.get("no_double_apply")
+        and out.get("jit_cache_bounded") and out.get("batch_bounded")
+        and out.get("restart_detected") and out.get("slo_shed_decision")
+        and out.get("serve_qps", 0) > 0)
+    if out_dir:
+        from geomx_tpu.telemetry.ledger import (get_request_ledger,
+                                                get_round_ledger)
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "serve_record.json"), "w") as f:
+            json.dump(out, f, indent=2, default=str)
+        with open(os.path.join(out_dir, "serve_ledger.json"), "w") as f:
+            json.dump({
+                "rounds": get_round_ledger().records(),
+                "requests": get_request_ledger().records(),
+                "request_summary": get_request_ledger().summary(),
+            }, f, indent=2, default=str)
+        out["artifacts"] = {"out_dir": out_dir}
+    return out
+
+
+def compare_serve_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--rounds="):
+            kwargs["rounds"] = int(a.split("=", 1)[1])
+        elif a.startswith("--requests="):
+            kwargs["qps_requests"] = int(a.split("=", 1)[1])
+        elif a.startswith("--clients="):
+            kwargs["clients"] = int(a.split("=", 1)[1])
+        elif a.startswith("--max-batch="):
+            kwargs["max_batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--queue-ms="):
+            kwargs["queue_ms"] = float(a.split("=", 1)[1])
+        elif a.startswith("--delta-frac="):
+            kwargs["delta_frac"] = float(a.split("=", 1)[1])
+        elif a.startswith("--seed="):
+            kwargs["seed"] = int(a.split("=", 1)[1])
+        elif a.startswith("--out-dir="):
+            kwargs["out_dir"] = a.split("=", 1)[1]
+    _emit(_compare_serve(**kwargs))
+
+
 def main():
     if "--compare-kernels" in sys.argv:
         # kernel micro-mode: in-process, single device is enough (no
@@ -6211,6 +6583,13 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         compare_mfu_main(sys.argv[1:])
+    elif "--serve" in sys.argv:
+        # serving-plane acceptance: host-plane registry/gateway plus a
+        # single-device jit'd forward — CPU backend, no mesh needed
+        # (env before the first jax import)
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        compare_serve_main(sys.argv[1:])
     elif "--compare-manyparty" in sys.argv:
         # many-party sharded-global-tier acceptance: pure service-plane
         # (sockets + numpy, 16+ worker threads), no jax mesh

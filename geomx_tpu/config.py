@@ -207,6 +207,20 @@ class GeoConfig:
     # minimum steps between two actuations of the same knob
     control_cooldown: int = 5
 
+    # ---- serving plane (serve/: model registry, serving replica,
+    # batched inference gateway; docs/serving.md).  The gateway binds
+    # POST /infer on serve_port (0 = ephemeral, read the server's bound
+    # port), coalesces requests for serve_queue_ms before dispatching a
+    # batch of at most serve_max_batch (padded to power-of-two buckets
+    # — the jit-cache bound), and serve_staleness_s is the replica-
+    # freshness bound the train-while-serving acceptance gates on.
+    # Host-plane only: these knobs never touch the traced train step
+    # (the jaxpr byte-identity pin in tests/test_serve.py).
+    serve_port: int = 0
+    serve_max_batch: int = 8
+    serve_queue_ms: float = 2.0
+    serve_staleness_s: float = 10.0
+
     # ---- resilience (resilience/: membership epochs, degraded-mode sync,
     # deterministic chaos; docs/resilience.md)
     # residual policy at a membership change: "reset" re-initializes
@@ -282,6 +296,13 @@ class GeoConfig:
             control_ratio_bounds=_env(
                 ["GEOMX_CONTROL_RATIO_BOUNDS"], "", str),
             control_cooldown=_env(["GEOMX_CONTROL_COOLDOWN"], 5, int),
+            serve_port=_env(["GEOMX_SERVE_PORT"], 0,
+                            lambda s: int(float(s))),
+            serve_max_batch=_env(["GEOMX_SERVE_MAX_BATCH"], 8,
+                                 lambda s: int(float(s))),
+            serve_queue_ms=_env(["GEOMX_SERVE_QUEUE_MS"], 2.0, float),
+            serve_staleness_s=_env(["GEOMX_SERVE_STALENESS_S"], 10.0,
+                                   float),
             resilience_residuals=_env(
                 ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
             resilience_min_live=_env(

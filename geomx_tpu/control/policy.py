@@ -1,7 +1,7 @@
 """Deterministic control policies: the Graft Pilot's decision brain.
 
 TSEngine (PAPER.md §6) chose its overlay once per round from measured
-throughput; the Graft Pilot generalizes that into three hysteresis-
+throughput; the Graft Pilot generalizes that into four hysteresis-
 guarded feedback policies over the telemetry plane's sensors
 (:mod:`~geomx_tpu.control.sensors`):
 
@@ -25,6 +25,11 @@ guarded feedback policies over the telemetry plane's sensors
   the widest measured uplink becomes the chain's sink-adjacent relay,
   exactly the paper's ASK1 pairing), with a minimum-gain margin so
   estimate noise cannot thrash the overlay.
+- :class:`SloPolicy` — serving-plane routing + shedding (PR 18,
+  docs/serving.md): re-point the replica refresh source at the widest
+  measured uplink, and shed inference load (explicit 503s, bounded
+  steps, Schmitt-guarded on the request-ledger p99) when the serving
+  SLO is breached.
 
 Everything here is a pure function of the observation stream plus
 bounded internal counters: the same seeded scenario produces the same
@@ -47,8 +52,9 @@ class Decision:
     """One actuation the pilot wants applied.
 
     ``kind``: ``"ratio"`` (value = absolute bsc ratio), ``"depth"``
-    (value = 0 or 1) or ``"relay"`` (value = party order, widest
-    first).  ``prev`` is the value being replaced; ``reason`` is a
+    (value = 0 or 1), ``"relay"`` (value = party order, widest first)
+    or ``"slo"`` (value = ``("shed", fraction)`` / ``("route",
+    party)``).  ``prev`` is the value being replaced; ``reason`` is a
     deterministic human-readable justification (no timestamps)."""
 
     step: int
@@ -351,6 +357,128 @@ class RelayPolicy:
                    f"(uplinks {widest:.3g} vs narrowest {narrowest:.3g})")
 
 
+class SloPolicy:
+    """Serving-SLO routing + shedding: the fourth policy family
+    (docs/serving.md "SLO policy").
+
+    The observation is the gateway's serving stats (``stats_fn`` — a
+    zero-arg callable returning ``{"p99_s", "queue_depth", ...}`` or
+    None before traffic) plus the shared ``LinkObservatory`` snapshot
+    already on the :class:`ControlObservation`.  Two deterministic
+    sub-decisions, both ``kind="slo"``:
+
+    - **shed** (``value=("shed", fraction)``): when the measured
+      request p99 exceeds ``target_p99_s`` for ``confirm`` consecutive
+      evaluations, the shed fraction rises by a bounded ``shed_step``;
+      when p99 falls under the Schmitt exit (``release_p99_s`` <
+      target) for ``confirm`` evaluations it steps back down.  Sheds
+      are explicit 503s the gateway counts — load the SLO cannot carry
+      is refused loudly, never queued into timeout loss;
+    - **route** (``value=("route", party)``): the refresh source is
+      re-pointed at the widest confident measured uplink from the link
+      snapshot — the same one ordering rule the relay policy uses, so
+      observatory and both overlay consumers can never disagree.
+
+    Same determinism contract as the other three families: pure
+    function of the observation stream + bounded counters; no wall
+    clock, no RNG."""
+
+    knob = "slo"
+
+    def __init__(self, stats_fn, target_p99_s: float = 0.5,
+                 release_p99_s: Optional[float] = None,
+                 shed_step: float = 0.1, shed_max: float = 0.9,
+                 confirm: int = 2, cooldown: int = 5,
+                 min_confidence: float = 0.5, peer: str = "global"):
+        if target_p99_s <= 0:
+            raise ValueError(
+                f"target_p99_s must be > 0 (got {target_p99_s!r})")
+        if release_p99_s is None:
+            release_p99_s = 0.5 * target_p99_s
+        if not 0.0 < release_p99_s < target_p99_s:
+            raise ValueError(
+                f"need 0 < release < target (got release={release_p99_s}, "
+                f"target={target_p99_s}) — equal thresholds are a "
+                "comparator, not hysteresis")
+        self.stats_fn = stats_fn
+        self.target_p99_s = float(target_p99_s)
+        self.release_p99_s = float(release_p99_s)
+        self.shed_step = max(1e-6, float(shed_step))
+        self.shed_max = min(1.0, max(0.0, float(shed_max)))
+        self.confirm = max(1, int(confirm))
+        self.cooldown = Cooldown(cooldown)
+        self.min_confidence = float(min_confidence)
+        self.peer = peer
+        self.current = 0.0            # active shed fraction
+        self.route: Optional[str] = None   # current refresh source
+        self._over_streak = 0
+        self._under_streak = 0
+
+    def _route_decision(self, obs: ControlObservation
+                        ) -> Optional[Decision]:
+        links = {rec["party"]: rec for rec in obs.links.values()
+                 if rec["peer"] == self.peer
+                 and rec["throughput_bps"] is not None
+                 and rec["confidence"] >= self.min_confidence}
+        if not links:
+            return None
+        from geomx_tpu.telemetry.links import relay_order
+        order = tuple(relay_order(links.values(), peer=self.peer))
+        widest = order[0]
+        if widest == self.route:
+            return None
+        prev = self.route
+        self.route = widest
+        return Decision(
+            step=obs.step, kind="slo", value=("route", widest),
+            prev=("route", prev),
+            reason=f"refresh source -> widest measured uplink {widest} "
+                   f"({links[widest]['throughput_bps']:.3g} B/s)")
+
+    def decide(self, obs: ControlObservation) -> Optional[Decision]:
+        # routing re-points freely (no cooldown contention with shed:
+        # it only fires when the widest uplink actually changes)
+        route = self._route_decision(obs)
+        if route is not None:
+            return route
+        stats = self.stats_fn() if self.stats_fn is not None else None
+        p99 = None if not stats else stats.get("p99_s")
+        if p99 is None:
+            self._over_streak = self._under_streak = 0
+            return None
+        if p99 > self.target_p99_s:
+            self._over_streak += 1
+            self._under_streak = 0
+        elif p99 < self.release_p99_s:
+            self._under_streak += 1
+            self._over_streak = 0
+        else:
+            # inside the hysteresis band: hold
+            self._over_streak = self._under_streak = 0
+            return None
+        want = self.current
+        if self._over_streak >= self.confirm \
+                and self.current < self.shed_max:
+            want = min(self.shed_max, self.current + self.shed_step)
+        elif self._under_streak >= self.confirm and self.current > 0.0:
+            want = max(0.0, self.current - self.shed_step)
+        if want == self.current or not self.cooldown.ready(obs.step):
+            return None
+        prev = self.current
+        self.current = want
+        self._over_streak = self._under_streak = 0
+        self.cooldown.fire(obs.step)
+        direction = "raise" if want > prev else "lower"
+        bound = self.target_p99_s if want > prev else self.release_p99_s
+        cmp = ">" if want > prev else "<"
+        return Decision(
+            step=obs.step, kind="slo", value=("shed", want),
+            prev=("shed", prev),
+            reason=f"{direction} shed to {want:.2f}: request p99 "
+                   f"{p99:.4g}s {cmp} {bound:.4g}s "
+                   f"for {self.confirm} evaluations")
+
+
 class GraftPilot:
     """The closed loop: sensors -> policies -> decisions, evaluated
     every ``interval`` steps.  Construction wires defaults from
@@ -359,9 +487,11 @@ class GraftPilot:
     def __init__(self, sensors, ratio: Optional[RatioPolicy] = None,
                  depth: Optional[DepthPolicy] = None,
                  relay: Optional[RelayPolicy] = None,
+                 slo: Optional[SloPolicy] = None,
                  interval: int = 1):
         self.sensors = sensors
-        self.policies = [p for p in (ratio, depth, relay) if p is not None]
+        self.policies = [p for p in (ratio, depth, relay, slo)
+                         if p is not None]
         if not self.policies:
             raise ValueError("GraftPilot needs at least one policy")
         self.interval = max(1, int(interval))

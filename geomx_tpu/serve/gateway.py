@@ -1,0 +1,456 @@
+"""Batched inference gateway: POST /infer -> bounded queue -> jit'd forward.
+
+The gateway is the serving plane's front door (docs/serving.md
+"Inference gateway").  Requests arrive over the shared HTTP exporter
+(:func:`~geomx_tpu.telemetry.export.start_http_exporter` — the same
+plumbing behind the scheduler's ``/metrics``/``/healthz``), coalesce
+into a bounded queue, and a continuous-batching worker drains them:
+
+- **coalescing**: the worker takes the first waiting request, then
+  keeps absorbing arrivals for at most ``queue_ms`` (or until
+  ``max_batch``) — latency is traded for batch efficiency by exactly
+  one knob;
+- **padded buckets, bounded jit cache**: a batch pads up to the next
+  power-of-two bucket ≤ ``max_batch``, so the jit cache holds at most
+  ``len(buckets)`` executables per input shape — request count can be
+  anything, compile count cannot (the pin in tests/test_serve.py);
+- **atomic weights**: the forward reads
+  :meth:`~geomx_tpu.serve.replica.ServingReplica.params` once per
+  batch — the replica's swap discipline means a mid-batch delta
+  refresh changes the NEXT batch's weights, never this one's;
+- **deterministic shedding**: the SLO policy's ``set_shed_fraction``
+  sheds by fractional accumulator (every shed is an explicit 503 the
+  client sees and the ``geomx_serve_requests_total{status="shed"}``
+  counter records — a shed request is refused, never lost);
+- **causal request ledger**: every request lands in the process-global
+  :class:`~geomx_tpu.telemetry.ledger.RequestLedger` with its
+  enqueue -> batch -> forward -> reply phase seconds, the p50/p99
+  surface ``GET /ledger`` serves.
+
+jax is imported lazily inside the forward path only — constructing a
+gateway (or importing this module) in a jax-free process is safe.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomx_tpu.serve import register_serving_surface
+from geomx_tpu.serve.replica import ServingReplica
+
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+# ---------------------------------------------------------------------------
+# params pytree <-> named-layer dict (the registry's schema).  The
+# 4-digit leaf index prefixes make dict insertion order == pytree leaf
+# order == the P3 "early layers first" refresh priority, and let
+# ``unflatten_params`` rebuild by simple name sort.
+# ---------------------------------------------------------------------------
+
+def flatten_params(tree) -> Tuple[Dict[str, np.ndarray], Any]:
+    """A jax pytree as ``({name: np.float32 array}, treedef)``."""
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    named: Dict[str, np.ndarray] = {}
+    for i, (path, leaf) in enumerate(flat):
+        name = f"{i:04d}{jax.tree_util.keystr(path)}"
+        named[name] = np.asarray(leaf, np.float32)
+    return named, treedef
+
+
+def unflatten_params(treedef, named: Dict[str, np.ndarray]):
+    """Inverse of :func:`flatten_params` (names sort by leaf index)."""
+    import jax
+    return jax.tree_util.tree_unflatten(
+        treedef, [named[k] for k in sorted(named)])
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to (and including) ``max_batch``."""
+    out = []
+    b = 1
+    while b < int(max_batch):
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(sorted(set(out)))
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error", "rid", "t_enqueue",
+                 "t_batch", "batch_size", "bucket")
+
+    def __init__(self, x: np.ndarray, rid: int):
+        self.x = x
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[str] = None
+        self.rid = rid
+        self.t_enqueue = time.time()
+        self.t_batch: Optional[float] = None
+        self.batch_size = 0
+        self.bucket = 0
+
+
+class InferenceGateway:
+    """Continuous-batching inference over one serving replica."""
+
+    def __init__(self, replica: ServingReplica, treedef,
+                 model_name: str = "mlp", num_classes: int = 10,
+                 max_batch: int = 8, queue_ms: float = 2.0,
+                 queue_cap: int = 256,
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 apply_fn: Optional[Callable] = None):
+        self.replica = replica
+        self.treedef = treedef
+        self.model_name = str(model_name)
+        self.num_classes = int(num_classes)
+        self.max_batch = max(1, int(max_batch))
+        self.queue_ms = max(0.0, float(queue_ms))
+        self.buckets = tuple(sorted(buckets)) if buckets \
+            else default_buckets(self.max_batch)
+        if self.buckets[-1] < self.max_batch:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} < max_batch "
+                f"{self.max_batch}: a full batch would have no bucket")
+        self._apply_fn = apply_fn          # overrides get_model (tests)
+        self._model = None
+        self._queue: "queue.Queue[Optional[_Request]]" = \
+            queue.Queue(maxsize=max(1, int(queue_cap)))
+        self._jit_cache: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._shed_fraction = 0.0
+        self._shed_acc = 0.0
+        self._running = False
+        self._worker: Optional[threading.Thread] = None
+        self.requests_ok = 0
+        self.requests_shed = 0
+        self.requests_error = 0
+        self.batches_dispatched = 0
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "InferenceGateway":
+        self._running = True
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name="serve-batcher", daemon=True)
+        self._worker.start()
+        register_serving_surface("gateway", self.surface_snapshot)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
+        if self._worker is not None:
+            self._worker.join(timeout=10.0)
+        register_serving_surface("gateway", None)
+
+    # ---- SLO hooks (control/policy.py SloPolicy actuates these) ------------
+
+    def set_shed_fraction(self, fraction: float) -> None:
+        with self._lock:
+            self._shed_fraction = min(1.0, max(0.0, float(fraction)))
+
+    def shed_fraction(self) -> float:
+        with self._lock:
+            return self._shed_fraction
+
+    def serving_stats(self) -> dict:
+        """The observation the SLO policy consumes: request-ledger
+        percentiles + live queue depth + the current shed fraction."""
+        from geomx_tpu.telemetry.ledger import get_request_ledger
+        s = get_request_ledger().summary()
+        return {"p50_s": s.get("total_p50_s"),
+                "p99_s": s.get("total_p99_s"),
+                "qps": s.get("qps"),
+                "queue_depth": self._queue.qsize(),
+                "shed_fraction": self.shed_fraction()}
+
+    # ---- submission --------------------------------------------------------
+
+    def submit(self, x: np.ndarray) -> _Request:
+        """Enqueue one example.  A full queue or an active shed marks
+        the request shed immediately (explicit refusal, never silent
+        loss)."""
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            shed = False
+            if self._shed_fraction > 0.0:
+                self._shed_acc += self._shed_fraction
+                if self._shed_acc >= 1.0:
+                    self._shed_acc -= 1.0
+                    shed = True
+        req = _Request(np.asarray(x, np.float32), rid)
+        if shed:
+            self._finish_shed(req)
+            return req
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self._finish_shed(req)
+            return req
+        self._observe_queue_depth()
+        return req
+
+    def _finish_shed(self, req: _Request) -> None:
+        req.error = "shed"
+        req.event.set()
+        self.requests_shed += 1
+        self._count_request("shed")
+        self._ledger_observe(req, status="shed", forward_s=0.0,
+                             reply_s=0.0)
+
+    # ---- the continuous-batching worker ------------------------------------
+
+    def _worker_loop(self) -> None:
+        while self._running:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is None:
+                return
+            batch = [first]
+            deadline = time.monotonic() + self.queue_ms / 1000.0
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._dispatch(batch)
+                    return
+                batch.append(nxt)
+            self._dispatch(batch)
+        # drain on stop: whatever is queued still gets an answer
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if req is not None:
+                self._dispatch([req])
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def jit_cache_size(self) -> int:
+        return len(self._jit_cache)
+
+    def _forward_fn(self, bucket: int, feat_shape: tuple):
+        """The jit'd forward for one padded bucket size (bounded cache:
+        one executable per (bucket, input feature shape))."""
+        key = (int(bucket),) + tuple(feat_shape)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        if self._apply_fn is not None:
+            # injected forward takes the flat named dict directly (tests
+            # and jax-light callers skip the treedef round-trip)
+            apply = self._apply_fn
+
+            def fwd(named_params, xb):
+                return apply(named_params, xb)
+        else:
+            if self._model is None:
+                from geomx_tpu.models import get_model
+                self._model = get_model(self.model_name,
+                                        num_classes=self.num_classes)
+            model = self._model
+
+            def fwd(named_params, xb):
+                variables = unflatten_params(self.treedef, named_params)
+                return model.apply(variables, xb, train=False)
+
+        fn = jax.jit(fwd)
+        self._jit_cache[key] = fn
+        return fn
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        t_batch = time.time()
+        n = len(batch)
+        bucket = self.bucket_for(n)
+        for r in batch:
+            r.t_batch = t_batch
+            r.batch_size = n
+            r.bucket = bucket
+        try:
+            xb = np.stack([r.x for r in batch]).astype(np.float32)
+            if bucket > n:
+                pad = np.zeros((bucket - n,) + xb.shape[1:], np.float32)
+                xb = np.concatenate([xb, pad], axis=0)
+            named = self.replica.params()
+            fn = self._forward_fn(bucket, xb.shape[1:])
+            t_f0 = time.time()
+            out = np.asarray(fn(named, xb))
+            forward_s = time.time() - t_f0
+            self.batches_dispatched += 1
+            self._observe_batch(n)
+            t_reply0 = time.time()
+            for i, r in enumerate(batch):
+                r.result = out[i]
+                r.event.set()
+            reply_s = time.time() - t_reply0
+            for r in batch:
+                self.requests_ok += 1
+                self._count_request("ok")
+                self._ledger_observe(r, status="ok",
+                                     forward_s=forward_s,
+                                     reply_s=reply_s)
+        except Exception as e:
+            for r in batch:
+                r.error = repr(e)
+                r.event.set()
+                self.requests_error += 1
+                self._count_request("error")
+                self._ledger_observe(r, status="error", forward_s=0.0,
+                                     reply_s=0.0)
+        self._observe_queue_depth()
+        self._observe_staleness()
+
+    # ---- telemetry ---------------------------------------------------------
+
+    def _count_request(self, status: str) -> None:
+        try:
+            from geomx_tpu.telemetry.registry import get_registry
+            get_registry().counter(
+                "geomx_serve_requests_total",
+                "Inference requests by terminal status",
+                ("status",)).labels(status=status).inc()
+        except Exception:
+            pass
+
+    def _observe_batch(self, n: int) -> None:
+        try:
+            from geomx_tpu.telemetry.registry import get_registry
+            get_registry().histogram(
+                "geomx_serve_batch_size",
+                "Dispatched inference batch sizes (pre-padding)",
+                buckets=BATCH_SIZE_BUCKETS).observe(float(n))
+        except Exception:
+            pass
+
+    def _observe_queue_depth(self) -> None:
+        try:
+            from geomx_tpu.telemetry.registry import get_registry
+            get_registry().gauge(
+                "geomx_serve_queue_depth",
+                "Inference requests waiting in the gateway queue"
+            ).set(float(self._queue.qsize()))
+        except Exception:
+            pass
+
+    def _observe_staleness(self) -> None:
+        try:
+            from geomx_tpu.telemetry.registry import get_registry
+            s = self.replica.staleness_s()
+            if s != float("inf"):
+                get_registry().gauge(
+                    "geomx_serve_replica_staleness_seconds",
+                    "Seconds since the serving replica's last "
+                    "successful weight refresh").set(float(s))
+        except Exception:
+            pass
+
+    def _ledger_observe(self, req: _Request, status: str,
+                        forward_s: float, reply_s: float) -> None:
+        try:
+            from geomx_tpu.telemetry.ledger import get_request_ledger
+            t_batch = req.t_batch if req.t_batch is not None \
+                else req.t_enqueue
+            get_request_ledger().observe(
+                rid=req.rid, t_enqueue=req.t_enqueue,
+                queue_s=max(0.0, t_batch - req.t_enqueue),
+                forward_s=forward_s, reply_s=reply_s,
+                batch_size=req.batch_size, bucket=req.bucket,
+                status=status)
+        except Exception:
+            pass
+
+    # ---- surfaces ----------------------------------------------------------
+
+    def surface_snapshot(self) -> dict:
+        """The ``/healthz`` serving block: published versions the
+        replica tracks, freshness, queue depth, terminal counts."""
+        return {"replica": self.replica.snapshot(),
+                "queue_depth": self._queue.qsize(),
+                "max_batch": self.max_batch,
+                "queue_ms": self.queue_ms,
+                "buckets": list(self.buckets),
+                "jit_cache_size": self.jit_cache_size(),
+                "shed_fraction": self.shed_fraction(),
+                "requests": {"ok": self.requests_ok,
+                             "shed": self.requests_shed,
+                             "error": self.requests_error},
+                "batches": self.batches_dispatched}
+
+    def infer_route(self, body: bytes) -> Tuple[int, bytes, str]:
+        """The ``POST /infer`` handler (wire shape in docs/serving.md):
+        ``{"inputs": [[...feature vector...], ...]}`` in, one output
+        row per input out.  Shed/overflow is an explicit 503."""
+        try:
+            doc = json.loads(body.decode("utf-8"))
+            rows = doc["inputs"] if "inputs" in doc else [doc["input"]]
+            xs = [np.asarray(r, np.float32) for r in rows]
+        except (ValueError, KeyError, TypeError) as e:
+            return (400, json.dumps(
+                {"error": f"bad request: {e!r}"}).encode("utf-8"),
+                "application/json")
+        reqs = [self.submit(x) for x in xs]
+        deadline = time.monotonic() + 30.0
+        for r in reqs:
+            if not r.event.wait(max(0.0, deadline - time.monotonic())):
+                r.error = "timeout"
+        if any(r.error == "shed" for r in reqs):
+            return (503, json.dumps(
+                {"error": "shed", "shed": sum(1 for r in reqs
+                                              if r.error == "shed")}
+            ).encode("utf-8"), "application/json")
+        if any(r.error for r in reqs):
+            return (500, json.dumps(
+                {"error": next(r.error for r in reqs if r.error)}
+            ).encode("utf-8"), "application/json")
+        out = {"outputs": [np.asarray(r.result).tolist() for r in reqs],
+               "version": self.replica.version,
+               "round": self.replica.last_round(),
+               "batch_sizes": [r.batch_size for r in reqs]}
+        return (200, json.dumps(out).encode("utf-8"), "application/json")
+
+    def serve_http(self, bind_host: str = "127.0.0.1", port: int = 0):
+        """Start the gateway's HTTP surface on the shared exporter:
+        ``POST /infer`` plus the standard ``GET`` routes (/metrics,
+        /healthz with the serving block, /ledger with the request
+        section).  Returns the server (caller owns shutdown)."""
+        from geomx_tpu.serve import serving_surface
+        from geomx_tpu.telemetry.export import start_http_exporter
+
+        def health():
+            out = {"status": "ok"}
+            s = serving_surface()
+            if s is not None:
+                out["serving"] = s
+            return out
+
+        return start_http_exporter(
+            bind_host, int(port), health_fn=health,
+            post_routes={"/infer": self.infer_route},
+            thread_name="serve-http")

@@ -1,0 +1,181 @@
+"""Per-party serving replica: the local dense copy deltas stream into.
+
+A :class:`ServingReplica` holds the dense fp32 params one party serves
+inference from.  It is fed two ways: a full base install (once, at
+version publish) and O(k) sparse pair deltas (every training round
+after).  Three properties matter more than anything else here:
+
+- **atomic swap, zero downtime**: a delta is applied to a COPY of the
+  target layer and the params dict reference swaps once under the
+  lock — the gateway's forward pass always reads a complete,
+  internally-consistent weight set, never a torn refresh (and a
+  restarting replica keeps serving its stale copy while it re-syncs);
+- **idempotent apply**: the replica dedups on the same ``(layer,
+  round)`` key the registry journals, so a refresh stream replayed
+  after a registry failover (or a session resume re-push) cannot
+  double-apply — with add semantics a double-apply is silent weight
+  corruption, not an error;
+- **restart detection**: every refresh reply carries the registry's
+  generation token; a change means the registry restarted, and
+  :meth:`sync` re-pulls from the replica's own last applied round —
+  the replica's dedup absorbs whatever the fresh registry re-sends.
+
+Freshness is tracked as both the last applied round and wall-clock
+seconds since the last successful refresh (``staleness_s``) — the
+numbers the scheduler's ``/healthz`` serving surface and the
+``geomx_serve_replica_staleness_seconds`` gauge report.
+
+Host-plane Python only (numpy, no jax): the gateway converts to device
+arrays at its own boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomx_tpu.compression.sparseagg import (decode_pairs_payload,
+                                             densify_pairs_host)
+from geomx_tpu.serve.registry import RegistryClient
+
+
+class ServingReplica:
+    """One party's serving copy of one published version."""
+
+    def __init__(self, version: str, party: int = 0):
+        self.version = str(version)
+        self.party = int(party)
+        self._lock = threading.Lock()
+        self._params: Dict[str, np.ndarray] = {}    # layer -> shaped fp32
+        self._order: List[str] = []
+        self._applied: set = set()                  # {(layer, round)}
+        self._last_round = 0
+        self._gen: Optional[int] = None
+        self._refresh_unix = 0.0
+        self.refreshes = 0
+        self.deltas_applied = 0
+        self.replays_deduped = 0
+        self.restarts_detected = 0
+
+    # ---- feeds -------------------------------------------------------------
+
+    def install_base(self, layer: str, arr: np.ndarray, order: int,
+                     shape: Optional[Tuple[int, ...]] = None) -> None:
+        arr = np.asarray(arr, np.float32)
+        if shape is not None:
+            arr = arr.reshape(tuple(shape))
+        with self._lock:
+            if layer not in self._params:
+                while len(self._order) <= order:
+                    self._order.append(None)
+                self._order[order] = layer
+            self._params = dict(self._params)       # copy-on-write swap
+            self._params[layer] = np.ascontiguousarray(arr)
+            self._refresh_unix = time.time()
+
+    def apply_delta(self, layer: str, round_id: int, vals: np.ndarray,
+                    idx: np.ndarray) -> bool:
+        """One pair delta onto a copy of the layer, then swap.  False =
+        deduped replay (already applied, nothing changed)."""
+        with self._lock:
+            if (layer, int(round_id)) in self._applied:
+                self.replays_deduped += 1
+                return False
+            cur = self._params[layer]
+            flat = cur.reshape(-1).copy()
+            densify_pairs_host(vals, idx, flat.size, out=flat)
+            self._params = dict(self._params)
+            self._params[layer] = flat.reshape(cur.shape)
+            self._applied.add((layer, int(round_id)))
+            self._last_round = max(self._last_round, int(round_id))
+            self.deltas_applied += 1
+            self._refresh_unix = time.time()
+            return True
+
+    def sync(self, client: RegistryClient) -> dict:
+        """One refresh round-trip: pull everything after our last
+        applied round (plus the base if we have nothing yet), apply
+        with dedup, adopt the registry's generation token.  A token
+        change is a detected restart — counted, and harmless, because
+        the pull already asked from OUR round, not the registry's."""
+        with self._lock:
+            since = self._last_round
+            need_base = not self._params
+            prev_gen = self._gen
+        frames, tail = client.pull_updates(self.version, since,
+                                           need_base=need_base)
+        applied = deduped = 0
+        for msg in frames:
+            _v, _, layer = (msg.key or "").partition("/")
+            if msg.meta.get("base"):
+                self.install_base(layer, msg.array,
+                                  int(msg.meta.get("order", 0)),
+                                  shape=tuple(msg.meta.get("shape", ())))
+                applied += 1
+            else:
+                vals, idx = decode_pairs_payload(msg.array)
+                if self.apply_delta(layer, int(msg.meta["round"]),
+                                    vals, idx):
+                    applied += 1
+                else:
+                    deduped += 1
+        gen = tail.get("gen")
+        with self._lock:
+            if prev_gen is not None and gen is not None \
+                    and gen != prev_gen:
+                self.restarts_detected += 1
+            self._gen = gen
+            self._refresh_unix = time.time()
+            self.refreshes += 1
+        return {"frames": len(frames), "applied": applied,
+                "deduped": deduped, "gen": gen,
+                "registry_last_round": tail.get("last_round"),
+                "restart_detected": prev_gen is not None
+                and gen is not None and gen != prev_gen}
+
+    # ---- reads -------------------------------------------------------------
+
+    def params(self) -> Dict[str, np.ndarray]:
+        """The CURRENT complete weight set (an immutable-by-convention
+        dict reference — the swap discipline means a caller may keep
+        using it for a whole forward pass)."""
+        with self._lock:
+            return self._params
+
+    def layer_order(self) -> List[str]:
+        with self._lock:
+            return [l for l in self._order if l is not None]
+
+    def last_round(self) -> int:
+        with self._lock:
+            return self._last_round
+
+    def generation(self) -> Optional[int]:
+        with self._lock:
+            return self._gen
+
+    def staleness_s(self, now: Optional[float] = None) -> float:
+        with self._lock:
+            if not self._refresh_unix:
+                return float("inf")
+            return max(0.0, (time.time() if now is None else now)
+                       - self._refresh_unix)
+
+    def snapshot(self) -> dict:
+        """The ``/healthz`` serving-surface row for this replica."""
+        with self._lock:
+            staleness = (float("inf") if not self._refresh_unix
+                         else max(0.0, time.time() - self._refresh_unix))
+            return {"version": self.version, "party": self.party,
+                    "layers": len(self._params),
+                    "last_round": self._last_round,
+                    "generation": self._gen,
+                    "staleness_s": (None if staleness == float("inf")
+                                    else round(staleness, 3)),
+                    "refreshes": self.refreshes,
+                    "deltas_applied": self.deltas_applied,
+                    "replays_deduped": self.replays_deduped,
+                    "restarts_detected": self.restarts_detected}

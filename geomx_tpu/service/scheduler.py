@@ -315,7 +315,7 @@ class GeoScheduler:
         alive = self.heartbeats.alive_nodes()
         dead = [] if self.in_restart_grace() \
             else self.heartbeats.dead_nodes()
-        return {
+        out = {
             "status": "ok",
             "roster_epoch": epoch,
             "roster": roster,
@@ -330,6 +330,19 @@ class GeoScheduler:
                               3),
             "build": dict(self.build_info),
         }
+        # serving surface (serve/, docs/serving.md): published model
+        # versions, replica freshness, infer queue depth — present only
+        # when a gateway/replica registered in this process.  Lazy and
+        # best-effort: the scheduler stays jax-free and a broken
+        # snapshot provider must never 500 the liveness probe.
+        try:
+            from geomx_tpu.serve import serving_surface
+            serving = serving_surface()
+            if serving is not None:
+                out["serving"] = serving
+        except Exception:
+            pass
+        return out
 
     # ---- key-range sharded global tier (scheduler-owned placement) ---------
 

@@ -218,20 +218,25 @@ def parse_prometheus_text(text: str) -> Dict[str, Dict[str, Any]]:
 
 def start_http_exporter(bind_host: str, port: int, health_fn=None,
                         routes: Optional[Dict[str, Any]] = None,
+                        post_routes: Optional[Dict[str, Any]] = None,
                         thread_name: str = "metrics-http"):
     """Serve the standard observability routes from a daemon HTTP
     thread: ``GET /metrics`` (Prometheus text exposition of the
     process-global registry), ``GET /healthz`` (``health_fn()`` as
     JSON), and ``GET /ledger`` (the process-global fleet round
-    ledger's records + summary, telemetry/ledger.py).  ``routes`` maps
-    extra paths to zero-arg callables returning ``(body_bytes,
-    content_type)`` (the scheduler adds ``/control``).  Returns the
-    ``ThreadingHTTPServer`` (``.server_address[1]`` is the bound port;
-    callers own ``shutdown()``/``server_close()``)."""
+    ledger's records + summary plus the serving plane's per-request
+    ledger when one exists, telemetry/ledger.py).  ``routes`` maps
+    extra GET paths to zero-arg callables returning ``(body_bytes,
+    content_type)`` (the scheduler adds ``/control``); ``post_routes``
+    maps POST paths to one-arg callables ``body_bytes -> (status,
+    body_bytes, content_type)`` (the serving gateway adds ``/infer``).
+    Returns the ``ThreadingHTTPServer`` (``.server_address[1]`` is the
+    bound port; callers own ``shutdown()``/``server_close()``)."""
     import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     extra = dict(routes or {})
+    extra_post = dict(post_routes or {})
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(h):  # noqa: N805 — http.server handler convention
@@ -245,12 +250,18 @@ def start_http_exporter(bind_host: str, port: int, health_fn=None,
                         health_fn(), default=_json_default).encode("utf-8")
                     ctype = "application/json"
                 elif route == "/ledger":
-                    from geomx_tpu.telemetry.ledger import get_round_ledger
+                    from geomx_tpu.telemetry.ledger import (
+                        get_round_ledger, peek_request_ledger)
                     led = get_round_ledger()
+                    doc = {"records": led.records(),
+                           "summary": led.summary()}
+                    req_led = peek_request_ledger()
+                    if req_led is not None:
+                        doc["requests"] = {
+                            "records": req_led.records(),
+                            "summary": req_led.summary()}
                     body = _json.dumps(
-                        {"records": led.records(),
-                         "summary": led.summary()},
-                        default=_json_default).encode("utf-8")
+                        doc, default=_json_default).encode("utf-8")
                     ctype = "application/json"
                 elif route in extra:
                     body, ctype = extra[route]()
@@ -263,6 +274,27 @@ def start_http_exporter(bind_host: str, port: int, health_fn=None,
                 h.end_headers()
                 return
             h.send_response(200)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+
+        def do_POST(h):  # noqa: N805 — http.server handler convention
+            route = h.path.partition("?")[0].rstrip("/")
+            fn = extra_post.get(route)
+            if fn is None:
+                h.send_response(404)
+                h.end_headers()
+                return
+            try:
+                n = int(h.headers.get("Content-Length") or 0)
+                payload = h.rfile.read(n) if n > 0 else b""
+                status, body, ctype = fn(payload)
+            except Exception:
+                h.send_response(500)
+                h.end_headers()
+                return
+            h.send_response(int(status))
             h.send_header("Content-Type", ctype)
             h.send_header("Content-Length", str(len(body)))
             h.end_headers()

@@ -601,3 +601,124 @@ def add_phase(key: str, round_id: int, phase: str, seconds: float) -> None:
 
 def complete_round(key: str, round_id: int) -> None:
     get_round_ledger().complete(key, round_id)
+
+
+# ---------------------------------------------------------------------------
+# per-request serving ledger (docs/serving.md): the RoundLedger traces
+# gradient rounds; this traces inference requests through the gateway's
+# causal chain — enqueue -> batch -> forward -> reply — with the same
+# bounded-ring discipline, and summarizes p50/p99 per phase for the
+# ``GET /ledger`` surface and the SLO policy's observation stream.
+# ---------------------------------------------------------------------------
+
+REQUEST_PHASES = ("queue", "forward", "reply")
+DEFAULT_REQUESTS = 2048
+
+
+def _request_capacity() -> int:
+    from geomx_tpu.config import _env
+    return max(1, _env(("GEOMX_LEDGER_REQUESTS",), DEFAULT_REQUESTS, int))
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(0, min(len(sorted_vals) - 1,
+                      int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[rank]
+
+
+class RequestLedger:
+    """Bounded FIFO ring of completed inference requests.
+
+    One record per request: the wall-clock enqueue instant, the three
+    phase durations (queue = enqueue->batch, forward = the jit'd batch
+    dispatch this request rode, reply = result fan-out), the dispatched
+    batch size and padded bucket, and the terminal status (``ok`` /
+    ``shed`` / ``error``).  Writes are a deque append under one lock —
+    cheap enough for the request path; reads snapshot."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = _request_capacity() if capacity is None \
+            else max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._records: "collections.deque" = \
+            collections.deque(maxlen=self.capacity)
+        self.observed_total = 0
+
+    def observe(self, rid: int, *, t_enqueue: float, queue_s: float,
+                forward_s: float, reply_s: float, batch_size: int,
+                bucket: int, status: str = "ok") -> None:
+        rec = {"rid": int(rid), "t_enqueue": float(t_enqueue),
+               "queue_s": float(queue_s), "forward_s": float(forward_s),
+               "reply_s": float(reply_s),
+               "total_s": float(queue_s) + float(forward_s)
+               + float(reply_s),
+               "batch_size": int(batch_size), "bucket": int(bucket),
+               "status": str(status)}
+        with self._lock:
+            self._records.append(rec)
+            self.observed_total += 1
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._records]
+
+    def summary(self) -> Dict[str, Any]:
+        """p50/p99 per phase + end-to-end, status counts, and the
+        retained window's sustained QPS (completed ``ok`` requests over
+        the window's enqueue span)."""
+        with self._lock:
+            recs = list(self._records)
+            total = self.observed_total
+        out: Dict[str, Any] = {"requests": len(recs),
+                               "observed_total": total}
+        by_status: Dict[str, int] = {}
+        for r in recs:
+            by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+        out["by_status"] = by_status
+        ok = [r for r in recs if r["status"] == "ok"]
+        for phase in REQUEST_PHASES + ("total",):
+            vals = sorted(r[f"{phase}_s"] for r in ok)
+            out[f"{phase}_p50_s"] = _percentile(vals, 0.50)
+            out[f"{phase}_p99_s"] = _percentile(vals, 0.99)
+        if len(ok) >= 2:
+            span = max(r["t_enqueue"] for r in ok) \
+                - min(r["t_enqueue"] for r in ok)
+            out["qps"] = len(ok) / span if span > 0 else None
+        else:
+            out["qps"] = None
+        if ok:
+            out["batch_size_mean"] = \
+                sum(r["batch_size"] for r in ok) / len(ok)
+            out["batch_size_max"] = max(r["batch_size"] for r in ok)
+        return out
+
+
+_request_ledger: Optional[RequestLedger] = None
+_request_ledger_lock = threading.Lock()
+
+
+def get_request_ledger() -> RequestLedger:
+    global _request_ledger
+    with _request_ledger_lock:
+        if _request_ledger is None:
+            _request_ledger = RequestLedger()
+        return _request_ledger
+
+
+def peek_request_ledger() -> Optional[RequestLedger]:
+    """The current request ledger WITHOUT creating one — the /ledger
+    HTTP route's probe, so a pure-training process never grows a
+    serving section."""
+    with _request_ledger_lock:
+        return _request_ledger
+
+
+def reset_request_ledger(capacity: Optional[int] = None) -> RequestLedger:
+    """Fresh global request ledger (test isolation / bench runs)."""
+    global _request_ledger
+    with _request_ledger_lock:
+        _request_ledger = RequestLedger(capacity=capacity)
+        return _request_ledger

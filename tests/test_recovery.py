@@ -294,3 +294,59 @@ def test_ts_dead_peer_fallback_completes_round():
     for c in (ca, cb):
         c.stop_server()
         c.close()
+
+
+def test_registry_kill_mid_refresh_replay_not_double_applied(tmp_path):
+    """Serving-plane idempotence under the kill-mid-refresh race
+    (docs/serving.md "Crash story"): a delta push lands and is
+    journaled, the registry dies before the trainer sees the ACK, and
+    the session-resume replay re-sends the SAME (sender, rid) frame to
+    the failover.  With add semantics a double-apply silently corrupts
+    weights — the journal-recovered dedup must absorb the replay."""
+    import numpy as np
+
+    from geomx_tpu.serve.registry import RegistryClient, RegistryServer
+    from geomx_tpu.serve.replica import ServingReplica
+
+    rng = np.random.default_rng(11)
+    srv = RegistryServer(durable_dir=str(tmp_path))
+    srv.start()
+    trainer = RegistryClient(srv.addr, sender=0, timeout_s=10.0)
+    params = {"0000/w": rng.normal(size=(16,)).astype(np.float32)}
+    trainer.publish("v1", params)
+    dense = {k: v.copy() for k, v in params.items()}
+
+    vals = rng.normal(size=4).astype(np.float32)
+    idx = np.array([1, 5, 9, 13], np.int64)
+    np.add.at(dense["0000/w"], idx, vals)
+    ack = trainer.push_delta("v1", 1, {"0000/w": (vals, idx)})
+    assert ack["applied_layers"] == 1
+
+    # the registry dies right after journaling — the trainer never
+    # learns whether round 1 landed, so on reconnect it must replay
+    srv.crash()
+    srv.join(5.0)
+    failover = RegistryServer(durable_dir=str(tmp_path))
+    failover.start()
+    assert failover.generation == srv.generation + 1
+
+    trainer2 = RegistryClient(failover.addr, sender=0, timeout_s=10.0)
+    # session-resume replay: same sender, same round, same payload
+    ack2 = trainer2.push_delta("v1", 1, {"0000/w": (vals, idx)})
+    assert ack2["applied_layers"] == 0          # absorbed, not re-added
+    assert failover.registry.replays_deduped >= 1
+
+    rep = ServingReplica("v1")
+    rcli = RegistryClient(failover.addr, sender=2, timeout_s=10.0)
+    out = rep.sync(rcli)
+    assert out["applied"] > 0
+    np.testing.assert_array_equal(rep.params()["0000/w"],
+                                  dense["0000/w"])
+
+    # materialized registry view agrees bit-exactly too
+    np.testing.assert_array_equal(
+        failover.registry.materialize("v1")["0000/w"], dense["0000/w"])
+    for c in (trainer, trainer2, rcli):
+        c.close()
+    failover.stop()
+    failover.join(5.0)

@@ -26,7 +26,11 @@ plus the cost model's max per-config relative error, lower is
 better), and ``TRANSFORMER_r*.json`` (the ``--compare-mfu``
 compute-phase-engine acceptance: fused-optimizer DCE / bf16-parity /
 prefetch booleans plus both workloads' roofline MFU, higher is
-better, and the prefetch-on host_stall fraction, lower is better).
+better, and the prefetch-on host_stall fraction, lower is better),
+and ``SERVE_r*.json`` (the ``--serve`` serving-plane acceptance:
+bit-exact delta reconstruction / delta-only refresh / zero-lost /
+no-double-apply booleans plus the gateway's sustained QPS, higher is
+better, and its serving p99, lower is better).
 Until now that history was write-only: a future capture could regress
 throughput or flip the multichip matrix red and nothing would notice
 until a human re-read the numbers.  This tool makes the trajectory a
@@ -82,6 +86,8 @@ DIRECTION = {
     "merge_speedup": "up",
     "cost_model_max_rel_err": "down",
     "host_stall_fraction": "down",
+    "serve_qps": "up",
+    "serve_p99_ms": "down",
 }
 
 
@@ -265,6 +271,21 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
         if isinstance(dev, dict) and dev.get("device_kind"):
             out["device_kind"] = dev["device_kind"]
         return out
+    if rec.get("mode") == "compare_serve":  # SERVE_r*
+        for gate in ("ok", "bit_exact", "delta_only",
+                     "staleness_bounded", "zero_lost",
+                     "chaos_p99_bounded", "no_double_apply",
+                     "jit_cache_bounded", "batch_bounded",
+                     "restart_detected", "slo_shed_decision"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        # machine-sensitive scalars (CPU speed, CI host load); the
+        # band still catches the gateway collapsing
+        if isinstance(rec.get("serve_qps"), (int, float)):
+            out["serve_qps"] = float(rec["serve_qps"])
+        if isinstance(rec.get("serve_p99_ms"), (int, float)):
+            out["serve_p99_ms"] = float(rec["serve_p99_ms"])
+        return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
                      "decision_log_deterministic",
@@ -404,7 +425,8 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
                             "MULTICHIP_r*.json", "CONTROL_r*.json",
                             "RECOVERY_r*.json", "MANYPARTY_r*.json",
                             "SPARSEAGG_r*.json", "FLEETOBS_r*.json",
-                            "CAPSULE_r*.json", "TRANSFORMER_r*.json"]
+                            "CAPSULE_r*.json", "TRANSFORMER_r*.json",
+                            "SERVE_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     raw_docs: Dict[str, List[dict]] = {}
     unreadable: List[str] = []

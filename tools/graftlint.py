@@ -26,12 +26,14 @@ Rules (docs/analysis.md has the catalog with examples):
           config.py (knobs route through GeoConfig/_env so launch
           scripts and docs stay the single source of truth)
 - GX-WIRE-001  pickle use (``dumps``/``loads``/``dump``/``load``/
-          ``Unpickler``) anywhere in geomx_tpu/service/ — the host
-          plane's wire hot path speaks the fixed-layout v0x02 binary
-          codec; pickling there reintroduces the per-frame
-          serializer cost the native fast path removed (and, for
-          loads, an attack surface).  The ONLY sanctioned waivers
-          are the legacy-compat v0x01 codec paths in protocol.py.
+          ``Unpickler``) anywhere in geomx_tpu/service/ or
+          geomx_tpu/serve/ — the host plane's wire hot path speaks
+          the fixed-layout v0x02 binary codec (the serving plane's
+          registry refresh rides the same frames); pickling there
+          reintroduces the per-frame serializer cost the native
+          fast path removed (and, for loads, an attack surface).
+          The ONLY sanctioned waivers are the legacy-compat v0x01
+          codec paths in protocol.py.
 
 Traced-scope detection (documented heuristics, module-local):
 
@@ -455,14 +457,18 @@ class ModuleLinter:
 
     def _check_service_pickle(self):
         # GX-WIRE-001: geomx_tpu/service/ is the wire hot path — every
-        # frame a worker pushes crosses this code.  The v0x02 binary
-        # codec exists precisely so no pickle runs per frame; any new
-        # pickle use here silently reintroduces that serializer cost
-        # (and for loads, an arbitrary-object decode surface).  Only
-        # the legacy-compat v0x01 encode/decode in protocol.py carries
-        # a sanctioned waiver.
-        sp = os.sep + os.path.join("geomx_tpu", "service") + os.sep
-        if sp not in os.path.abspath(self.path):
+        # frame a worker pushes crosses this code — and geomx_tpu/serve/
+        # rides the same frames for its registry refresh stream.  The
+        # v0x02 binary codec exists precisely so no pickle runs per
+        # frame; any new pickle use here silently reintroduces that
+        # serializer cost (and for loads, an arbitrary-object decode
+        # surface).  Only the legacy-compat v0x01 encode/decode in
+        # protocol.py carries a sanctioned waiver.
+        ap = os.path.abspath(self.path)
+        gated = any(
+            os.sep + os.path.join("geomx_tpu", d) + os.sep in ap
+            for d in ("service", "serve"))
+        if not gated:
             return
         names = ("dumps", "loads", "dump", "load", "Unpickler")
         for node in ast.walk(self.tree):
