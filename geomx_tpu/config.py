@@ -212,14 +212,18 @@ class GeoConfig:
     # POST /infer on serve_port (0 = ephemeral, read the server's bound
     # port), coalesces requests for serve_queue_ms before dispatching a
     # batch of at most serve_max_batch (padded to power-of-two buckets
-    # — the jit-cache bound), and serve_staleness_s is the replica-
-    # freshness bound the train-while-serving acceptance gates on.
+    # — the jit-cache bound), serve_staleness_s is the replica-
+    # freshness bound the train-while-serving acceptance gates on, and
+    # serve_timeout_s is the per-request client deadline: a request
+    # still queued past it answers 500 and is skipped (counted
+    # "timeout", never "ok") if a batch picks it up later.
     # Host-plane only: these knobs never touch the traced train step
     # (the jaxpr byte-identity pin in tests/test_serve.py).
     serve_port: int = 0
     serve_max_batch: int = 8
     serve_queue_ms: float = 2.0
     serve_staleness_s: float = 10.0
+    serve_timeout_s: float = 30.0
 
     # ---- resilience (resilience/: membership epochs, degraded-mode sync,
     # deterministic chaos; docs/resilience.md)
@@ -303,6 +307,8 @@ class GeoConfig:
             serve_queue_ms=_env(["GEOMX_SERVE_QUEUE_MS"], 2.0, float),
             serve_staleness_s=_env(["GEOMX_SERVE_STALENESS_S"], 10.0,
                                    float),
+            serve_timeout_s=_env(["GEOMX_SERVE_TIMEOUT_S"], 30.0,
+                                 float),
             resilience_residuals=_env(
                 ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
             resilience_min_live=_env(

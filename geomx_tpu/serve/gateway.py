@@ -49,10 +49,22 @@ BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 # ---------------------------------------------------------------------------
 # params pytree <-> named-layer dict (the registry's schema).  The
-# 4-digit leaf index prefixes make dict insertion order == pytree leaf
-# order == the P3 "early layers first" refresh priority, and let
-# ``unflatten_params`` rebuild by simple name sort.
+# leaf index prefixes (zero-padded to 4 digits, wider when the tree
+# needs it) make dict insertion order == pytree leaf order == the P3
+# "early layers first" refresh priority; ``unflatten_params`` rebuilds
+# by parsing the integer prefix — NOT a lexicographic name sort, which
+# would put "10000..." before "9999..." and silently reorder leaves.
 # ---------------------------------------------------------------------------
+
+def _leaf_index(name: str) -> int:
+    """The integer leaf index a :func:`flatten_params` name starts with."""
+    i = 0
+    while i < len(name) and name[i].isdigit():
+        i += 1
+    if i == 0:
+        raise ValueError(f"param name {name!r} has no leaf-index prefix")
+    return int(name[:i])
+
 
 def flatten_params(tree) -> Tuple[Dict[str, np.ndarray], Any]:
     """A jax pytree as ``({name: np.float32 array}, treedef)``."""
@@ -66,10 +78,16 @@ def flatten_params(tree) -> Tuple[Dict[str, np.ndarray], Any]:
 
 
 def unflatten_params(treedef, named: Dict[str, np.ndarray]):
-    """Inverse of :func:`flatten_params` (names sort by leaf index)."""
+    """Inverse of :func:`flatten_params` (names sort by their integer
+    leaf-index prefix; the sequence must be contiguous from 0)."""
     import jax
+    keys = sorted(named, key=_leaf_index)
+    if [_leaf_index(k) for k in keys] != list(range(len(keys))):
+        raise ValueError(
+            "named params do not form a contiguous 0..n-1 leaf-index "
+            "sequence — refusing to rebuild a reordered pytree")
     return jax.tree_util.tree_unflatten(
-        treedef, [named[k] for k in sorted(named)])
+        treedef, [named[k] for k in keys])
 
 
 def default_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -85,7 +103,8 @@ def default_buckets(max_batch: int) -> Tuple[int, ...]:
 
 class _Request:
     __slots__ = ("x", "event", "result", "error", "rid", "t_enqueue",
-                 "t_batch", "batch_size", "bucket")
+                 "t_batch", "batch_size", "bucket", "_taken_lock",
+                 "_taken")
 
     def __init__(self, x: np.ndarray, rid: int):
         self.x = x
@@ -97,6 +116,20 @@ class _Request:
         self.t_batch: Optional[float] = None
         self.batch_size = 0
         self.bucket = 0
+        self._taken_lock = threading.Lock()
+        self._taken = False
+
+    def take(self) -> bool:
+        """Claim terminal ownership — exactly one caller wins.  The
+        batch worker takes before dispatching; the HTTP thread takes on
+        client-deadline expiry — so a request that timed out while
+        queued is skipped by a later batch (and counted "timeout"),
+        never double-finished or counted "ok" after its 500."""
+        with self._taken_lock:
+            if self._taken:
+                return False
+            self._taken = True
+            return True
 
 
 class InferenceGateway:
@@ -107,7 +140,8 @@ class InferenceGateway:
                  max_batch: int = 8, queue_ms: float = 2.0,
                  queue_cap: int = 256,
                  buckets: Optional[Tuple[int, ...]] = None,
-                 apply_fn: Optional[Callable] = None):
+                 apply_fn: Optional[Callable] = None,
+                 request_timeout_s: Optional[float] = None):
         self.replica = replica
         self.treedef = treedef
         self.model_name = str(model_name)
@@ -120,6 +154,10 @@ class InferenceGateway:
             raise ValueError(
                 f"largest bucket {self.buckets[-1]} < max_batch "
                 f"{self.max_batch}: a full batch would have no bucket")
+        if request_timeout_s is None:
+            from geomx_tpu.config import GeoConfig
+            request_timeout_s = GeoConfig.from_env().serve_timeout_s
+        self.request_timeout_s = max(0.001, float(request_timeout_s))
         self._apply_fn = apply_fn          # overrides get_model (tests)
         self._model = None
         self._queue: "queue.Queue[Optional[_Request]]" = \
@@ -134,6 +172,7 @@ class InferenceGateway:
         self.requests_ok = 0
         self.requests_shed = 0
         self.requests_error = 0
+        self.requests_timeout = 0
         self.batches_dispatched = 0
 
     # ---- lifecycle ---------------------------------------------------------
@@ -205,12 +244,32 @@ class InferenceGateway:
         return req
 
     def _finish_shed(self, req: _Request) -> None:
+        req.take()          # fresh request, unqueued: always wins
         req.error = "shed"
         req.event.set()
-        self.requests_shed += 1
+        # every ThreadingHTTPServer thread calls submit concurrently —
+        # the counter bump must sit under the gateway lock or the
+        # read-modify-write race loses sheds from the zero-lost books
+        with self._lock:
+            self.requests_shed += 1
         self._count_request("shed")
         self._ledger_observe(req, status="shed", forward_s=0.0,
                              reply_s=0.0)
+
+    def _finish_timeout(self, req: _Request) -> bool:
+        """Finish a request whose client deadline expired while it was
+        still queued.  False = a batch worker already claimed it (the
+        forward is in flight and the result/event are imminent)."""
+        if not req.take():
+            return False
+        req.error = "timeout"
+        req.event.set()
+        with self._lock:
+            self.requests_timeout += 1
+        self._count_request("timeout")
+        self._ledger_observe(req, status="timeout", forward_s=0.0,
+                             reply_s=0.0)
+        return True
 
     # ---- the continuous-batching worker ------------------------------------
 
@@ -286,6 +345,14 @@ class InferenceGateway:
         return fn
 
     def _dispatch(self, batch: List[_Request]) -> None:
+        # claim each request first: one that timed out while queued was
+        # already finished (500 + "timeout" accounting) by the HTTP
+        # thread — running it anyway would count it "ok" after the
+        # client gave up
+        batch = [r for r in batch if r.take()]
+        if not batch:
+            self._observe_queue_depth()
+            return
         t_batch = time.time()
         n = len(batch)
         bucket = self.bucket_for(n)
@@ -395,12 +462,14 @@ class InferenceGateway:
                 "queue_depth": self._queue.qsize(),
                 "max_batch": self.max_batch,
                 "queue_ms": self.queue_ms,
+                "request_timeout_s": self.request_timeout_s,
                 "buckets": list(self.buckets),
                 "jit_cache_size": self.jit_cache_size(),
                 "shed_fraction": self.shed_fraction(),
                 "requests": {"ok": self.requests_ok,
                              "shed": self.requests_shed,
-                             "error": self.requests_error},
+                             "error": self.requests_error,
+                             "timeout": self.requests_timeout},
                 "batches": self.batches_dispatched}
 
     def infer_route(self, body: bytes) -> Tuple[int, bytes, str]:
@@ -416,18 +485,23 @@ class InferenceGateway:
                 {"error": f"bad request: {e!r}"}).encode("utf-8"),
                 "application/json")
         reqs = [self.submit(x) for x in xs]
-        deadline = time.monotonic() + 30.0
+        deadline = time.monotonic() + self.request_timeout_s
         for r in reqs:
             if not r.event.wait(max(0.0, deadline - time.monotonic())):
-                r.error = "timeout"
+                if not self._finish_timeout(r):
+                    # a worker claimed it mid-forward: the result is
+                    # imminent — wait it out rather than race the
+                    # ok-accounting with a fabricated timeout
+                    r.event.wait(self.request_timeout_s)
         if any(r.error == "shed" for r in reqs):
             return (503, json.dumps(
                 {"error": "shed", "shed": sum(1 for r in reqs
                                               if r.error == "shed")}
             ).encode("utf-8"), "application/json")
-        if any(r.error for r in reqs):
+        if any(r.error or r.result is None for r in reqs):
             return (500, json.dumps(
-                {"error": next(r.error for r in reqs if r.error)}
+                {"error": next((r.error or "timeout") for r in reqs
+                               if r.error or r.result is None)}
             ).encode("utf-8"), "application/json")
         out = {"outputs": [np.asarray(r.result).tolist() for r in reqs],
                "version": self.replica.version,

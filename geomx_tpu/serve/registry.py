@@ -244,10 +244,20 @@ class ModelRegistry:
             return out
 
     def pending(self, version: str, since_round: int,
-                need_base: bool = False) -> List[dict]:
+                need_base: bool = False,
+                since_layers: Optional[Dict[str, int]] = None
+                ) -> List[dict]:
         """The P3 refresh plan: layer-major in publish order (early
         layers first), rounds ascending within a layer; optional dense
-        base frames (same priority order) ahead of the deltas."""
+        base frames (same priority order) ahead of the deltas.
+
+        ``since_layers`` (replica watermarks, layer -> last applied
+        round) filters each layer against ITS OWN cursor; a layer the
+        map doesn't mention falls back to ``since_round``.  This is
+        what makes a partially-landed round safe: a replica that
+        already applied layer A's round N still has layer B at N-1 in
+        its map, so B's round-N delta stays pending instead of being
+        dropped behind a global ``r > N`` filter."""
         with self._lock:
             vs = self._versions.get(str(version))
             if vs is None:
@@ -261,8 +271,10 @@ class ModelRegistry:
                                  "shape": list(vs.shapes[layer]),
                                  "arr": vs.base[layer]})
             for layer in layers:
+                cut = int(since_round) if since_layers is None \
+                    else int(since_layers.get(layer, since_round))
                 for r, vals, idx in vs.deltas.get(layer, ()):
-                    if r > int(since_round):
+                    if r > cut:
                         plan.append({"layer": layer, "base": False,
                                      "round": r, "vals": vals,
                                      "idx": idx,
@@ -397,15 +409,28 @@ class RegistryServer:
         if msg.type == MsgType.PUSH:
             version, _, layer = (msg.key or "").partition("/")
             meta = msg.meta
-            if meta.get("base"):
-                reg.publish_layer(version, layer, msg.array,
-                                  int(meta.get("order", 0)))
-                applied = True
-            else:
-                vals, idx = decode_pairs_payload(msg.array)
-                applied = reg.apply_delta(
-                    version, layer, int(meta["round"]), vals, idx,
-                    sender=msg.sender, rid=meta.get("rid"))
+            # a bad PUSH (unpublished version/layer, oversized pair
+            # payload, garbage frame) must answer with an ERROR frame,
+            # not a torn-down socket — the client would otherwise
+            # retry the identical frame and surface an opaque
+            # ConnectionError instead of the real cause
+            try:
+                if meta.get("base"):
+                    reg.publish_layer(version, layer, msg.array,
+                                      int(meta.get("order", 0)))
+                    applied = True
+                else:
+                    vals, idx = decode_pairs_payload(msg.array)
+                    applied = reg.apply_delta(
+                        version, layer, int(meta["round"]), vals, idx,
+                        sender=msg.sender, rid=meta.get("rid"))
+            except (KeyError, ValueError, TypeError) as e:
+                send_frame(conn, Msg(
+                    MsgType.ERROR, sender=-1,
+                    meta={"error": f"{type(e).__name__}: {e}",
+                          "gen": reg.generation,
+                          "rid": meta.get("rid", 0)}))
+                return True
             send_frame(conn, Msg(
                 MsgType.ACK, sender=-1,
                 meta={"gen": reg.generation, "applied": int(applied),
@@ -414,24 +439,35 @@ class RegistryServer:
             return True
         if msg.type == MsgType.PULL:
             version = msg.key or ""
-            plan = reg.pending(version, int(msg.meta.get("since", 0)),
-                               need_base=bool(msg.meta.get("need_base")))
-            for f in plan:
-                if f["base"]:
-                    arr = f["arr"]
-                    meta = {"version": version, "base": 1,
-                            "order": f["order"], "round": 0,
-                            "shape": f["shape"],
-                            "wire_declared": int(arr.nbytes)}
-                else:
-                    arr = encode_pairs_payload(f["vals"], f["idx"])
-                    meta = {"version": version, "base": 0,
-                            "round": f["round"], "n": f["n"],
-                            "comp": "pairs",
-                            "wire_declared": int(arr.nbytes)}
-                send_frame(conn, Msg(MsgType.PULL_REPLY,
-                                     key=f"{version}/{f['layer']}",
-                                     sender=-1, meta=meta, array=arr))
+            try:
+                since_layers = msg.meta.get("since_layers")
+                plan = reg.pending(
+                    version, int(msg.meta.get("since", 0)),
+                    need_base=bool(msg.meta.get("need_base")),
+                    since_layers=since_layers)
+                for f in plan:
+                    if f["base"]:
+                        arr = f["arr"]
+                        meta = {"version": version, "base": 1,
+                                "order": f["order"], "round": 0,
+                                "shape": f["shape"],
+                                "wire_declared": int(arr.nbytes)}
+                    else:
+                        arr = encode_pairs_payload(f["vals"], f["idx"])
+                        meta = {"version": version, "base": 0,
+                                "round": f["round"], "n": f["n"],
+                                "comp": "pairs",
+                                "wire_declared": int(arr.nbytes)}
+                    send_frame(conn, Msg(MsgType.PULL_REPLY,
+                                         key=f"{version}/{f['layer']}",
+                                         sender=-1, meta=meta, array=arr))
+            except (KeyError, ValueError, TypeError) as e:
+                send_frame(conn, Msg(
+                    MsgType.ERROR, sender=-1,
+                    meta={"error": f"{type(e).__name__}: {e}",
+                          "gen": reg.generation,
+                          "rid": msg.meta.get("rid", 0)}))
+                return True
             send_frame(conn, Msg(
                 MsgType.ACK, sender=-1,
                 meta={"gen": reg.generation, "frames": len(plan),
@@ -563,6 +599,9 @@ class RegistryClient:
                           "rid": self.next_rid(),
                           "wire_declared": int(arr.nbytes)},
                     array=arr), retries=retries)
+                if rep.type == MsgType.ERROR:
+                    raise RuntimeError(
+                        rep.meta.get("error", "publish failed"))
                 ack = dict(rep.meta)
         return ack
 
@@ -592,17 +631,25 @@ class RegistryClient:
         return ack
 
     def pull_updates(self, version: str, since_round: int,
-                     need_base: bool = False
+                     need_base: bool = False,
+                     since_layers: Optional[Dict[str, int]] = None
                      ) -> Tuple[List[Msg], dict]:
         """Refresh stream: every pending frame (base first when asked,
-        then deltas in P3 order) plus the terminal ACK meta."""
+        then deltas in P3 order) plus the terminal ACK meta.
+        ``since_layers`` carries the replica's per-layer watermarks so
+        a partially-landed round is never filtered out (see
+        :meth:`ModelRegistry.pending`)."""
         with self._lock:
             sock = self._conn()
+            meta = {"since": int(since_round),
+                    "need_base": int(bool(need_base)),
+                    "rid": self.next_rid()}
+            if since_layers:
+                meta["since_layers"] = {str(k): int(v)
+                                        for k, v in since_layers.items()}
             send_frame(sock, Msg(
                 MsgType.PULL, key=str(version), sender=self.sender,
-                meta={"since": int(since_round),
-                      "need_base": int(bool(need_base)),
-                      "rid": self.next_rid()}))
+                meta=meta))
             frames: List[Msg] = []
             while True:
                 rep = recv_frame(sock)

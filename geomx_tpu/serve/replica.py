@@ -17,8 +17,15 @@ after).  Three properties matter more than anything else here:
   corruption, not an error;
 - **restart detection**: every refresh reply carries the registry's
   generation token; a change means the registry restarted, and
-  :meth:`sync` re-pulls from the replica's own last applied round —
-  the replica's dedup absorbs whatever the fresh registry re-sends.
+  :meth:`sync` re-pulls from the replica's own watermarks — the
+  replica's dedup absorbs whatever the fresh registry re-sends;
+- **per-layer watermarks**: a training round is one PUSH per layer,
+  so the registry can transiently hold round N for layer A but not
+  yet layer B.  :meth:`sync` therefore sends a per-layer ``since``
+  map (last round applied to THAT layer), and the registry filters
+  its pending plan per layer — a sync landing mid-round re-pulls the
+  straggler layer's round-N delta on the next refresh instead of
+  filtering it out behind a global round cursor forever.
 
 Freshness is tracked as both the last applied round and wall-clock
 seconds since the last successful refresh (``staleness_s``) — the
@@ -52,6 +59,7 @@ class ServingReplica:
         self._params: Dict[str, np.ndarray] = {}    # layer -> shaped fp32
         self._order: List[str] = []
         self._applied: set = set()                  # {(layer, round)}
+        self._layer_rounds: Dict[str, int] = {}     # layer -> last applied
         self._last_round = 0
         self._gen: Optional[int] = None
         self._refresh_unix = 0.0
@@ -74,6 +82,7 @@ class ServingReplica:
                 self._order[order] = layer
             self._params = dict(self._params)       # copy-on-write swap
             self._params[layer] = np.ascontiguousarray(arr)
+            self._layer_rounds.setdefault(layer, 0)
             self._refresh_unix = time.time()
 
     def apply_delta(self, layer: str, round_id: int, vals: np.ndarray,
@@ -90,23 +99,33 @@ class ServingReplica:
             self._params = dict(self._params)
             self._params[layer] = flat.reshape(cur.shape)
             self._applied.add((layer, int(round_id)))
+            self._layer_rounds[layer] = max(
+                self._layer_rounds.get(layer, 0), int(round_id))
             self._last_round = max(self._last_round, int(round_id))
             self.deltas_applied += 1
             self._refresh_unix = time.time()
             return True
 
     def sync(self, client: RegistryClient) -> dict:
-        """One refresh round-trip: pull everything after our last
-        applied round (plus the base if we have nothing yet), apply
-        with dedup, adopt the registry's generation token.  A token
-        change is a detected restart — counted, and harmless, because
-        the pull already asked from OUR round, not the registry's."""
+        """One refresh round-trip: pull everything after our per-layer
+        watermarks (plus the base if we have nothing yet), apply with
+        dedup, adopt the registry's generation token.  A token change
+        is a detected restart — counted, and harmless, because the
+        pull already asked from OUR watermarks, not the registry's.
+
+        The since map is per layer — a train-while-serving sync that
+        lands mid-round (registry holds round N for layer A, layer B
+        still in flight) leaves layer B's watermark at N-1, so B's
+        round-N delta is still pending on the next pull even though
+        the replica's global round already reads N."""
         with self._lock:
-            since = self._last_round
+            since_layers = dict(self._layer_rounds)
+            since = min(since_layers.values(), default=0)
             need_base = not self._params
             prev_gen = self._gen
         frames, tail = client.pull_updates(self.version, since,
-                                           need_base=need_base)
+                                           need_base=need_base,
+                                           since_layers=since_layers)
         applied = deduped = 0
         for msg in frames:
             _v, _, layer = (msg.key or "").partition("/")

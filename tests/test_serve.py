@@ -210,6 +210,83 @@ def test_compaction_preserves_state_and_dedup(tmp_path):
     reg2.close()
 
 
+def test_partial_round_push_is_not_lost_across_sync():
+    """The train-while-serving race: push_delta is one PUSH per layer,
+    so a replica sync can land when the registry holds round N for
+    layer A but not yet layer B.  The per-layer since map must keep
+    B's round-N delta pending — a global ``r > since`` cursor would
+    filter it out forever and silently diverge the replica."""
+    rng = np.random.default_rng(12)
+    srv = RegistryServer()
+    srv.start()
+    trainer = RegistryClient(srv.addr, sender=0, timeout_s=10.0)
+    params = {"0000/a": rng.normal(size=(8,)).astype(np.float32),
+              "0001/b": rng.normal(size=(6,)).astype(np.float32)}
+    trainer.publish("v1", params)
+    dense = {k: v.copy() for k, v in params.items()}
+
+    rcli = RegistryClient(srv.addr, sender=1, timeout_s=10.0)
+    rep = ServingReplica("v1")
+    rep.sync(rcli)
+    try:
+        # round 1: layer A lands, then the replica syncs IN the window
+        # before layer B's round-1 push arrives
+        va = np.float32([0.5, -0.5])
+        ia = np.array([0, 3], np.int64)
+        trainer.push_delta("v1", 1, {"0000/a": (va, ia)})
+        np.add.at(dense["0000/a"], ia, va)
+        mid = rep.sync(rcli)
+        assert mid["applied"] == 1
+        assert rep.last_round() == 1        # global cursor already at 1
+
+        # layer B's round-1 delta lands late
+        vb = np.float32([1.0])
+        ib = np.array([2], np.int64)
+        trainer.push_delta("v1", 1, {"0001/b": (vb, ib)})
+        np.add.at(dense["0001/b"], ib, vb)
+
+        # the next sync must still deliver B/1 (and dedup a re-sent A/1)
+        out = rep.sync(rcli)
+        assert out["applied"] == 1, "straggler layer's round was lost"
+        served = rep.params()
+        for k in dense:
+            assert np.array_equal(served[k], dense[k]), k
+    finally:
+        trainer.close()
+        rcli.close()
+        srv.stop()
+        srv.join(5.0)
+
+
+def test_bad_push_answers_error_frame_not_dead_socket():
+    """A PUSH for an unpublished version (or unknown layer) must come
+    back as an ERROR frame the client surfaces as the real cause — not
+    a torn-down connection retried into an opaque ConnectionError.
+    The connection stays usable afterwards."""
+    rng = np.random.default_rng(13)
+    srv = RegistryServer()
+    srv.start()
+    cli = RegistryClient(srv.addr, sender=0, timeout_s=10.0)
+    try:
+        vals = np.ones(1, np.float32)
+        idx = np.zeros(1, np.int64)
+        with pytest.raises(RuntimeError, match="unpublished"):
+            cli.push_delta("ghost", 1, {"0000/w": (vals, idx)})
+        # unknown layer on a published version: also an ERROR frame
+        cli.publish("v1", {"0000/w": rng.normal(size=(4,))
+                           .astype(np.float32)})
+        with pytest.raises(RuntimeError, match="no base layer"):
+            cli.push_delta("v1", 1, {"9999/nope": (vals, idx)})
+        # same socket still serves good pushes
+        ack = cli.push_delta("v1", 1, {"0000/w": (vals, idx)})
+        assert ack["applied_layers"] == 1
+        assert cli.replays_sent == 0        # no blind reconnect-retry
+    finally:
+        cli.close()
+        srv.stop()
+        srv.join(5.0)
+
+
 # --------------------------------------------------------------------------
 # replica
 # --------------------------------------------------------------------------
@@ -318,6 +395,56 @@ def test_gateway_shed_is_explicit_not_lost():
         assert gw.requests_shed == 1
     finally:
         gw.stop()
+
+
+def test_unflatten_params_handles_five_digit_leaf_indices():
+    """10000+ leaves: "10000..." sorts lexicographically before
+    "9999...", so unflatten must order by the parsed integer leaf-index
+    prefix, not by name string — a silent reorder is corrupt params."""
+    import jax  # noqa: F401 — tree round-trip needs jax
+
+    from geomx_tpu.serve.gateway import flatten_params, unflatten_params
+
+    tree = [np.float32([i]) for i in range(10001)]
+    named, treedef = flatten_params(tree)
+    assert sorted(named) != list(named)     # lexicographic order lies
+    rebuilt = unflatten_params(treedef, named)
+    assert all(np.array_equal(a, b) for a, b in zip(rebuilt, tree))
+    # a gap in the index sequence is refused, never silently reordered
+    broken = dict(named)
+    broken.pop(next(iter(broken)))
+    with pytest.raises(ValueError, match="contiguous"):
+        unflatten_params(treedef, broken)
+
+
+def test_timed_out_request_never_counted_ok():
+    """A request that ages out in the queue answers 500/"timeout" and
+    is SKIPPED when the worker later reaches it — dispatching it anyway
+    would count it "ok" in metrics/ledger after the client already got
+    its 500, overcounting successes under overload."""
+    reset_request_ledger()
+    rng = np.random.default_rng(14)
+    rep = ServingReplica("v1")
+    rep.install_base("0000/w", rng.normal(size=(6, 3)).astype(np.float32),
+                     order=0)
+    gw = InferenceGateway(rep, treedef=None, max_batch=4, queue_ms=1.0,
+                          apply_fn=lambda named, xb: xb @ named["0000/w"],
+                          request_timeout_s=0.05)
+    # worker NOT started: the request times out while still queued
+    status, body, _ = gw.infer_route(
+        json.dumps({"inputs": [[1, 0, 0, 0, 0, 0]]}).encode())
+    assert status == 500 and b"timeout" in body
+    assert gw.requests_timeout == 1
+    # now the worker drains the stale entry: skipped, never forwarded
+    gw.start()
+    deadline = time.time() + 5.0
+    while gw._queue.qsize() and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)
+    gw.stop()
+    assert gw.requests_ok == 0
+    assert gw.batches_dispatched == 0
+    assert gw.surface_snapshot()["requests"]["timeout"] == 1
 
 
 def test_gateway_stop_drains_queue():
@@ -517,11 +644,18 @@ def test_serve_knobs_from_env(monkeypatch):
     monkeypatch.setenv("GEOMX_SERVE_MAX_BATCH", "32")
     monkeypatch.setenv("GEOMX_SERVE_QUEUE_MS", "7.5")
     monkeypatch.setenv("GEOMX_SERVE_STALENESS_S", "30")
+    monkeypatch.setenv("GEOMX_SERVE_TIMEOUT_S", "12.5")
     cfg = GeoConfig.from_env()
     assert cfg.serve_port == 9090
     assert cfg.serve_max_batch == 32
     assert cfg.serve_queue_ms == 7.5
     assert cfg.serve_staleness_s == 30.0
+    assert cfg.serve_timeout_s == 12.5
+    # the gateway's default request deadline comes from the same knob
+    rep = ServingReplica("v1")
+    gw = InferenceGateway(rep, treedef=None,
+                          apply_fn=lambda named, xb: xb)
+    assert gw.request_timeout_s == 12.5
 
 
 def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
@@ -547,13 +681,15 @@ def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
                         serve_port=cfg.serve_port,
                         serve_max_batch=cfg.serve_max_batch,
                         serve_queue_ms=cfg.serve_queue_ms,
-                        serve_staleness_s=cfg.serve_staleness_s)
+                        serve_staleness_s=cfg.serve_staleness_s,
+                        serve_timeout_s=cfg.serve_timeout_s)
         return Trainer(MLP(num_classes=10, hidden=(32,)), topo,
                        optax.sgd(0.1), sync=get_sync_algorithm(cfg),
                        config=cfg, donate=False)
 
     for var in ("GEOMX_SERVE_PORT", "GEOMX_SERVE_MAX_BATCH",
-                "GEOMX_SERVE_QUEUE_MS", "GEOMX_SERVE_STALENESS_S"):
+                "GEOMX_SERVE_QUEUE_MS", "GEOMX_SERVE_STALENESS_S",
+                "GEOMX_SERVE_TIMEOUT_S"):
         monkeypatch.delenv(var, raising=False)
     tr = build()
     rng = np.random.RandomState(0)
@@ -569,6 +705,7 @@ def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
     monkeypatch.setenv("GEOMX_SERVE_MAX_BATCH", "64")
     monkeypatch.setenv("GEOMX_SERVE_QUEUE_MS", "9.0")
     monkeypatch.setenv("GEOMX_SERVE_STALENESS_S", "1.0")
+    monkeypatch.setenv("GEOMX_SERVE_TIMEOUT_S", "5.0")
     tr2 = build()
     j_serving = canonicalize_jaxpr(
         str(jax.make_jaxpr(tr2.train_step)(state, xb, yb)))
