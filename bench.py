@@ -6141,7 +6141,6 @@ def _serve_http_load(port, xs, n_requests, clients, rows_per_req,
     (or run until ``stop_evt`` when n_requests is None).  Every request
     is accounted: ok (2xx), shed (503) or error — the zero-lost gate is
     issued == ok + shed + error."""
-    import math
     import urllib.error
     import urllib.request
 
@@ -6158,11 +6157,11 @@ def _serve_http_load(port, xs, n_requests, clients, rows_per_req,
         body = json.dumps({"inputs": rows}).encode()
         req = urllib.request.Request(
             url, data=body, headers={"Content-Type": "application/json"})
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             with urllib.request.urlopen(req, timeout=deadline_s) as r:
                 doc = json.loads(r.read())
-                dt = time.time() - t0
+                dt = time.monotonic() - t0
                 with lock:
                     stats["ok"] += 1
                     stats["latencies_s"].append(dt)
@@ -6171,7 +6170,7 @@ def _serve_http_load(port, xs, n_requests, clients, rows_per_req,
             e.read()
             with lock:
                 stats["shed" if e.code == 503 else "error"] += 1
-                stats["latencies_s"].append(time.time() - t0)
+                stats["latencies_s"].append(time.monotonic() - t0)
         except Exception:
             with lock:
                 stats["error"] += 1
@@ -6187,14 +6186,24 @@ def _serve_http_load(port, xs, n_requests, clients, rows_per_req,
                 stats["issued"] += 1
             one_request(rng)
 
-    t0 = time.time()
+    _run_load_threads(worker, clients, stats, deadline_s)
+    return stats
+
+
+def _run_load_threads(worker, clients, stats, deadline_s):
+    """Shared load-gen tail: spawn client threads, then fold raw
+    latencies into p50/p99 + sustained QPS (monotonic elapsed — a wall
+    step mid-load must not fake a QPS number)."""
+    import math
+
+    t0 = time.monotonic()
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                for w in range(clients)]
     for t in threads:
         t.start()
     for t in threads:
         t.join(deadline_s * 4)
-    stats["elapsed_s"] = time.time() - t0
+    stats["elapsed_s"] = time.monotonic() - t0
     lat = sorted(stats["latencies_s"])
 
     def pct(q):
@@ -6206,6 +6215,60 @@ def _serve_http_load(port, xs, n_requests, clients, rows_per_req,
     stats["qps"] = (stats["ok"] / stats["elapsed_s"]
                     if stats["elapsed_s"] > 0 else 0.0)
     del stats["latencies_s"]
+
+
+def _serve_native_load(port, xs, n_requests, clients, rows_per_req,
+                       stop_evt=None, deadline_s=30.0):
+    """Native-wire twin of ``_serve_http_load``: ONE persistent binary
+    connection per client thread speaking INFER/INFER_REPLY frames (the
+    serving fast path, docs/serving.md) — no per-request TCP connect,
+    no JSON float text.  Identical zero-lost bookkeeping: issued ==
+    ok + shed + error, shed is the server's explicit refusal frame."""
+    import numpy as np
+
+    from geomx_tpu.serve.infer_wire import NativeInferenceClient
+
+    lock = threading.Lock()
+    stats = {"issued": 0, "ok": 0, "shed": 0, "error": 0,
+             "latencies_s": [], "batch_sizes": []}
+
+    def worker(wid):
+        rng = np.random.default_rng(2000 + wid)
+        cli = NativeInferenceClient(("127.0.0.1", port),
+                                    timeout_s=deadline_s)
+        try:
+            while True:
+                with lock:
+                    if n_requests is not None \
+                            and stats["issued"] >= n_requests:
+                        return
+                    if stop_evt is not None and stop_evt.is_set():
+                        return
+                    stats["issued"] += 1
+                xb = np.stack([xs[rng.integers(0, len(xs))]
+                               for _ in range(rows_per_req)])
+                t0 = time.monotonic()
+                try:
+                    rep = cli.infer(xb)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        if "outputs" in rep:
+                            stats["ok"] += 1
+                            stats["latencies_s"].append(dt)
+                            stats["batch_sizes"].extend(
+                                rep.get("batch_sizes", []))
+                        elif rep.get("error") == "shed":
+                            stats["shed"] += 1
+                            stats["latencies_s"].append(dt)
+                        else:
+                            stats["error"] += 1
+                except Exception:
+                    with lock:
+                        stats["error"] += 1
+        finally:
+            cli.close()
+
+    _run_load_threads(worker, clients, stats, deadline_s)
     return stats
 
 
@@ -6222,7 +6285,9 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
     from geomx_tpu.serve.gateway import (InferenceGateway, flatten_params)
     from geomx_tpu.serve.registry import RegistryClient, RegistryServer
     from geomx_tpu.serve.replica import ServingReplica
-    from geomx_tpu.telemetry.ledger import (get_round_ledger,
+    from geomx_tpu.serve.infer_wire import serve_native
+    from geomx_tpu.telemetry.ledger import (get_request_ledger,
+                                            get_round_ledger,
                                             reset_request_ledger,
                                             reset_round_ledger)
 
@@ -6260,33 +6325,85 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
     first = replica.sync(replica_cli)
     out["base_sync"] = first
 
+    # the fast path (docs/serving.md "Serving fast path"): every
+    # (bucket, input-shape) executable compiles in start(), BEFORE the
+    # first request — the r01 p99/p50 gap was first-request compiles
     gw = InferenceGateway(replica, treedef=treedef, model_name="mlp",
                           num_classes=10, max_batch=max_batch,
-                          queue_ms=queue_ms)
+                          queue_ms=queue_ms, warmup_shapes=[(feat,)])
     gw.start()
+    out["warmup_compiles"] = int(gw.warmup_compiles)
     httpd = gw.serve_http(port=cfg.serve_port)
     port = httpd.server_address[1]
+    nsrv = serve_native(gw, port=0)      # None when the knob is off
+    out["native_wire_enabled"] = nsrv is not None
     xs = rng.normal(size=(16, feat)).astype(np.float32)
+
+    def _fill(sizes):
+        # mean dispatched batch over the bucket ceiling: 1.0 = every
+        # forward ran full, the r01 ragged-batch waste eliminated
+        return (round(sum(sizes) / (len(sizes) * max_batch), 4)
+                if sizes else None)
 
     try:
         # ---- phase A: sustained QPS at the target batch -----------------
-        _serve_http_load(port, xs, 8, 2, rows_per_req)  # jit warmup
+        _serve_http_load(port, xs, 8, 2, rows_per_req)  # warm http door
         reset_request_ledger()
-        load = _serve_http_load(port, xs, qps_requests, clients,
-                                rows_per_req)
+        load_http = _serve_http_load(port, xs, qps_requests, clients,
+                                     rows_per_req)
+        load = load_http
+        if nsrv is not None:
+            # headline QPS is the native lane; http stays reported as
+            # the slow door so the trend gate can watch both
+            load = _serve_native_load(nsrv.port, xs, qps_requests,
+                                      clients, rows_per_req)
+            out["qps_phase_http"] = load_http
+            out["serve_qps_http"] = round(load_http["qps"], 2)
+            out["serve_p50_ms_http"] = round(
+                1e3 * (load_http["p50_s"] or 0.0), 3)
+            out["serve_p99_ms_http"] = round(
+                1e3 * (load_http["p99_s"] or 0.0), 3)
+            out["batch_fill_fraction_http"] = _fill(
+                load_http["batch_sizes"])
         out["qps_phase"] = load
+        out["serve_transport"] = "native" if nsrv is not None else "http"
         out["serve_qps"] = round(load["qps"], 2)
         out["serve_p50_ms"] = round(1e3 * (load["p50_s"] or 0.0), 3)
         out["serve_p99_ms"] = round(1e3 * (load["p99_s"] or 0.0), 3)
-        out["batch_max_seen"] = int(max(load["batch_sizes"] or [0]))
+        out["batch_fill_fraction"] = _fill(load["batch_sizes"])
+        out["batch_max_seen"] = int(max(
+            (load["batch_sizes"] or [0]) + (load_http["batch_sizes"]
+                                            or [0])))
         out["jit_cache_size"] = gw.jit_cache_size()
         out["jit_cache_bounded"] = bool(
             gw.jit_cache_size() <= len(gw.buckets))
         out["batch_bounded"] = bool(out["batch_max_seen"] <= max_batch)
+        # pre-warm pins compiles out of request latency: the cache must
+        # still hold EXACTLY the executables start() compiled — any
+        # growth means a request paid a compile after all
+        out["prewarm_no_recompile"] = bool(
+            out["warmup_compiles"] > 0
+            and gw.jit_cache_size() == out["warmup_compiles"])
+        if nsrv is not None:
+            # byte-true honesty audit: actual on-wire frame bytes vs
+            # the sender's declared payload, from the request ledger's
+            # per-transport lanes.  Gated on the payload-bearing
+            # request direction (replies are a 10-class logits row —
+            # header-dominated by construction, reported not gated).
+            lane = get_request_ledger().summary().get(
+                "wire", {}).get("native", {})
+            out["native_wire"] = lane
+            hr = lane.get("honesty_ratio_rx")
+            out["native_honesty_ratio"] = hr
+            out["native_wire_honest"] = bool(
+                hr is not None and hr <= 1.02)
 
         # ---- phase B: train-while-serving, delta-only refresh ----------
+        # background load runs over BOTH doors: refresh correctness and
+        # staleness hold under the fast path, not just the http lane
         stop_evt = threading.Event()
         bg_stats = {}
+        bg_native_stats = {}
 
         def bg_load():
             bg_stats.update(_serve_http_load(
@@ -6294,6 +6411,13 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
 
         bg = threading.Thread(target=bg_load, daemon=True)
         bg.start()
+        bg_n = None
+        if nsrv is not None:
+            bg_n = threading.Thread(
+                target=lambda: bg_native_stats.update(_serve_native_load(
+                    nsrv.port, xs, None, 2, rows_per_req,
+                    stop_evt=stop_evt)), daemon=True)
+            bg_n.start()
         max_staleness = 0.0
         for r in range(1, rounds + 1):
             layers = {}
@@ -6311,11 +6435,16 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
             max_staleness = max(max_staleness, replica.staleness_s())
         stop_evt.set()
         bg.join(30.0)
+        if bg_n is not None:
+            bg_n.join(30.0)
         out["train_while_serving"] = {
             "bg_requests": bg_stats.get("issued", 0),
             "bg_ok": bg_stats.get("ok", 0),
             "bg_shed": bg_stats.get("shed", 0),
             "bg_error": bg_stats.get("error", 0),
+            "bg_native_requests": bg_native_stats.get("issued", 0),
+            "bg_native_ok": bg_native_stats.get("ok", 0),
+            "bg_native_error": bg_native_stats.get("error", 0),
             "max_staleness_s": round(max_staleness, 3),
         }
         out["staleness_bounded"] = bool(
@@ -6358,6 +6487,7 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
         reset_request_ledger()
         stop_evt2 = threading.Event()
         chaos_stats = {}
+        chaos_native_stats = {}
 
         def chaos_load():
             chaos_stats.update(_serve_http_load(
@@ -6365,6 +6495,15 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
 
         bg2 = threading.Thread(target=chaos_load, daemon=True)
         bg2.start()
+        bg2_n = None
+        if nsrv is not None:
+            bg2_n = threading.Thread(
+                target=lambda: chaos_native_stats.update(
+                    _serve_native_load(nsrv.port, xs, None, 2,
+                                       rows_per_req,
+                                       stop_evt=stop_evt2)),
+                daemon=True)
+            bg2_n.start()
 
         chaos_round = rounds + 1
         layers = {}
@@ -6408,14 +6547,25 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
 
         stop_evt2.set()
         bg2.join(30.0)
+        if bg2_n is not None:
+            bg2_n.join(30.0)
         out["chaos_load"] = chaos_stats
-        lost = (chaos_stats.get("issued", 0)
-                - chaos_stats.get("ok", 0) - chaos_stats.get("shed", 0)
-                - chaos_stats.get("error", 0))
-        out["zero_lost"] = bool(
-            lost == 0 and chaos_stats.get("error", 0) == 0
-            and chaos_stats.get("issued", 0) > 0)
-        chaos_p99 = chaos_stats.get("p99_s") or 0.0
+        if nsrv is not None:
+            out["chaos_load_native"] = chaos_native_stats
+
+        def _lane_zero_lost(st):
+            lost = (st.get("issued", 0) - st.get("ok", 0)
+                    - st.get("shed", 0) - st.get("error", 0))
+            return (lost == 0 and st.get("error", 0) == 0
+                    and st.get("issued", 0) > 0)
+
+        # zero-lost and the chaos p99 bound must hold on EVERY door
+        # that took load — a native request lost during failover is as
+        # lost as an http one
+        lanes = [chaos_stats] + ([chaos_native_stats]
+                                 if nsrv is not None else [])
+        out["zero_lost"] = bool(all(_lane_zero_lost(s) for s in lanes))
+        chaos_p99 = max(s.get("p99_s") or 0.0 for s in lanes)
         out["chaos_p99_ms"] = round(1e3 * chaos_p99, 3)
         out["chaos_p99_bounded"] = bool(0.0 < chaos_p99 < 2.0)
         out["no_double_apply"] = bool(no_double_apply)
@@ -6440,6 +6590,8 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
         failover.stop()
         failover.join(5.0)
     finally:
+        if nsrv is not None:
+            nsrv.stop()
         httpd.shutdown()
         gw.stop()
         trainer.close()
@@ -6448,13 +6600,17 @@ def _compare_serve(rounds: int = 5, qps_requests: int = 120,
         srv.join(5.0)
 
     out["elapsed_s"] = round(time.time() - t_bench0, 3)
+    native_ok = (nsrv is None) or bool(
+        out.get("native_wire_honest")
+        and out.get("serve_qps_http", 0) > 0)
     out["ok"] = bool(
         out.get("bit_exact") and out.get("delta_only")
         and out.get("staleness_bounded") and out.get("zero_lost")
         and out.get("chaos_p99_bounded") and out.get("no_double_apply")
         and out.get("jit_cache_bounded") and out.get("batch_bounded")
         and out.get("restart_detected") and out.get("slo_shed_decision")
-        and out.get("serve_qps", 0) > 0)
+        and out.get("prewarm_no_recompile")
+        and out.get("serve_qps", 0) > 0 and native_ok)
     if out_dir:
         from geomx_tpu.telemetry.ledger import (get_request_ledger,
                                                 get_round_ledger)

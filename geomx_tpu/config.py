@@ -224,6 +224,13 @@ class GeoConfig:
     serve_queue_ms: float = 2.0
     serve_staleness_s: float = 10.0
     serve_timeout_s: float = 30.0
+    # serving fast path (docs/serving.md "Serving fast path"):
+    # serve_warmup pre-compiles every (bucket, input-shape) executable
+    # at gateway start so no served request pays a compile;
+    # serve_native_wire gates the persistent-connection binary /infer
+    # lane (the v0x02 TLV frames) next to the HTTP door.
+    serve_warmup: bool = True
+    serve_native_wire: bool = True
 
     # ---- resilience (resilience/: membership epochs, degraded-mode sync,
     # deterministic chaos; docs/resilience.md)
@@ -309,6 +316,9 @@ class GeoConfig:
                                    float),
             serve_timeout_s=_env(["GEOMX_SERVE_TIMEOUT_S"], 30.0,
                                  float),
+            serve_warmup=_env_bool(["GEOMX_SERVE_WARMUP"], True),
+            serve_native_wire=_env_bool(["GEOMX_SERVE_NATIVE_WIRE"],
+                                        True),
             resilience_residuals=_env(
                 ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
             resilience_min_live=_env(

@@ -142,6 +142,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                        ctypes.c_int64, ctypes.c_void_p,
                                        ctypes.c_void_p]
         lib.gx_merge_pairs.restype = ctypes.c_int64
+        lib.gx_scatter_pairs.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_int64]
+        lib.gx_scatter_pairs.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -197,6 +201,44 @@ def merge_pairs(vals, idx):
     m = lib.gx_merge_pairs(vals.ctypes.data, idx.ctypes.data, n,
                            out_v.ctypes.data, out_i.ctypes.data)
     return out_v[:m].copy(), out_i[:m].copy()
+
+
+def scatter_pairs(out, vals, idx) -> Optional[int]:
+    """Nogil in-place pair scatter-add: ``out[idx[i]] += vals[i]`` in
+    order (sentinels idx<0 dropped) — bit-identical to
+    compression.sparseagg.densify_pairs_host's np.add.at fold.  ``out``
+    must be a C-contiguous float32 1-D array; ``vals``/``idx`` must
+    already be contiguous f32/i64 (the serving replica's delta decode
+    hands them over in exactly that form — no silent copies here, a
+    copy would defeat the O(k) point).  Returns the applied pair count,
+    or None when the native runtime is unavailable (caller falls back
+    to the numpy path).  Raises on an out-of-range index — the native
+    side checks bounds before any write, so a bad delta never
+    half-applies."""
+    lib = load_native()
+    if lib is None:
+        return None
+    import numpy as np
+    if not (isinstance(out, np.ndarray) and out.dtype == np.float32
+            and out.ndim == 1 and out.flags["C_CONTIGUOUS"]
+            and out.flags["WRITEABLE"]):
+        raise ValueError("out must be a writable C-contiguous float32 "
+                         "1-D ndarray")
+    if not (isinstance(vals, np.ndarray) and vals.dtype == np.float32
+            and vals.flags["C_CONTIGUOUS"]):
+        raise ValueError("vals must be a C-contiguous float32 ndarray")
+    if not (isinstance(idx, np.ndarray) and idx.dtype == np.int64
+            and idx.flags["C_CONTIGUOUS"]):
+        raise ValueError("idx must be a C-contiguous int64 ndarray")
+    k = int(vals.size)
+    if k != int(idx.size):
+        raise ValueError(f"pair arrays disagree: {k} vs {idx.size}")
+    applied = lib.gx_scatter_pairs(out.ctypes.data, int(out.size),
+                                   vals.ctypes.data, idx.ctypes.data, k)
+    if applied < 0:
+        raise IndexError(
+            f"pair delta index out of range for size-{out.size} layer")
+    return int(applied)
 
 
 class NativePriorityQueue:

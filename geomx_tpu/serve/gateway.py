@@ -3,13 +3,25 @@
 The gateway is the serving plane's front door (docs/serving.md
 "Inference gateway").  Requests arrive over the shared HTTP exporter
 (:func:`~geomx_tpu.telemetry.export.start_http_exporter` — the same
-plumbing behind the scheduler's ``/metrics``/``/healthz``), coalesce
-into a bounded queue, and a continuous-batching worker drains them:
+plumbing behind the scheduler's ``/metrics``/``/healthz``) or the
+native binary ``/infer`` lane (serve/infer_wire.py), coalesce into one
+bounded queue, and a continuous-batching worker drains them:
 
-- **coalescing**: the worker takes the first waiting request, then
-  keeps absorbing arrivals for at most ``queue_ms`` (or until
-  ``max_batch``) — latency is traded for batch efficiency by exactly
-  one knob;
+- **coalescing, deadline-or-full**: the worker takes the first waiting
+  request, then absorbs arrivals until the batch FILLS or ``queue_ms``
+  expires — a full batch closes the instant it fills, it never sleeps
+  out the window;
+- **pipelined double-buffered dispatch** (the GEOMX_PREFETCH pattern):
+  while batch *t* runs on device behind jax's async dispatch, the
+  worker is already draining and assembling batch *t+1* into a
+  persistent pre-allocated padded bucket buffer (one copy per request,
+  no per-batch ``np.stack`` allocation) — host assembly and device
+  compute overlap instead of serializing;
+- **pre-warmed buckets**: :meth:`warmup` (run by :meth:`start` when
+  input shapes are known) compiles every (bucket, input-shape)
+  executable up front, so first-request compilation never lands inside
+  a served request's latency — counted in the
+  ``geomx_serve_warmup_compiles`` gauge, jit cache still bounded;
 - **padded buckets, bounded jit cache**: a batch pads up to the next
   power-of-two bucket ≤ ``max_batch``, so the jit cache holds at most
   ``len(buckets)`` executables per input shape — request count can be
@@ -24,8 +36,12 @@ into a bounded queue, and a continuous-batching worker drains them:
   counter records — a shed request is refused, never lost);
 - **causal request ledger**: every request lands in the process-global
   :class:`~geomx_tpu.telemetry.ledger.RequestLedger` with its
-  enqueue -> batch -> forward -> reply phase seconds, the p50/p99
-  surface ``GET /ledger`` serves.
+  enqueue -> batch -> forward -> reply phase seconds and transport
+  lane, the p50/p99 surface ``GET /ledger`` serves.
+
+All latency/deadline arithmetic runs on ``time.monotonic()`` — a wall
+clock step mid-run must not corrupt p50/p99 or the request deadline;
+wall clock survives only as the ledger record's enqueue anchor.
 
 jax is imported lazily inside the forward path only — constructing a
 gateway (or importing this module) in a jax-free process is safe.
@@ -103,19 +119,24 @@ def default_buckets(max_batch: int) -> Tuple[int, ...]:
 
 class _Request:
     __slots__ = ("x", "event", "result", "error", "rid", "t_enqueue",
-                 "t_batch", "batch_size", "bucket", "_taken_lock",
-                 "_taken")
+                 "t_enqueue_unix", "t_batch", "batch_size", "bucket",
+                 "transport", "_taken_lock", "_taken")
 
-    def __init__(self, x: np.ndarray, rid: int):
+    def __init__(self, x: np.ndarray, rid: int,
+                 transport: str = "local"):
         self.x = x
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[str] = None
         self.rid = rid
-        self.t_enqueue = time.time()
+        # monotonic for every latency/deadline computation; wall clock
+        # kept ONLY as the ledger record's anchor
+        self.t_enqueue = time.monotonic()
+        self.t_enqueue_unix = time.time()
         self.t_batch: Optional[float] = None
         self.batch_size = 0
         self.bucket = 0
+        self.transport = transport
         self._taken_lock = threading.Lock()
         self._taken = False
 
@@ -141,7 +162,9 @@ class InferenceGateway:
                  queue_cap: int = 256,
                  buckets: Optional[Tuple[int, ...]] = None,
                  apply_fn: Optional[Callable] = None,
-                 request_timeout_s: Optional[float] = None):
+                 request_timeout_s: Optional[float] = None,
+                 warmup_shapes: Optional[List[tuple]] = None,
+                 warmup: Optional[bool] = None):
         self.replica = replica
         self.treedef = treedef
         self.model_name = str(model_name)
@@ -154,15 +177,28 @@ class InferenceGateway:
             raise ValueError(
                 f"largest bucket {self.buckets[-1]} < max_batch "
                 f"{self.max_batch}: a full batch would have no bucket")
-        if request_timeout_s is None:
+        if request_timeout_s is None or warmup is None:
             from geomx_tpu.config import GeoConfig
-            request_timeout_s = GeoConfig.from_env().serve_timeout_s
+            cfg = GeoConfig.from_env()
+            if request_timeout_s is None:
+                request_timeout_s = cfg.serve_timeout_s
+            if warmup is None:
+                warmup = cfg.serve_warmup
         self.request_timeout_s = max(0.001, float(request_timeout_s))
+        self.warmup_shapes = [tuple(int(d) for d in s)
+                              for s in (warmup_shapes or [])]
+        self._warmup_enabled = bool(warmup)
         self._apply_fn = apply_fn          # overrides get_model (tests)
         self._model = None
         self._queue: "queue.Queue[Optional[_Request]]" = \
             queue.Queue(maxsize=max(1, int(queue_cap)))
         self._jit_cache: Dict[tuple, Any] = {}
+        # persistent padded host buffers, two per (bucket, feat shape)
+        # key: the worker assembles batch t+1 into the OTHER buffer
+        # while batch t's host->device transfer may still be reading
+        # its own — ping-pong, never a per-batch np.stack allocation
+        self._host_bufs: Dict[tuple, List[np.ndarray]] = {}
+        self._buf_flip: Dict[tuple, int] = {}
         self._lock = threading.Lock()
         self._rid = 0
         self._shed_fraction = 0.0
@@ -174,16 +210,58 @@ class InferenceGateway:
         self.requests_error = 0
         self.requests_timeout = 0
         self.batches_dispatched = 0
+        self.warmup_compiles = 0
 
     # ---- lifecycle ---------------------------------------------------------
 
     def start(self) -> "InferenceGateway":
+        if self._warmup_enabled and self.warmup_shapes:
+            # compile BEFORE the worker serves: the r01 p99/p50 gap was
+            # first-request bucket compiles landing inside request
+            # latency
+            self.warmup()
         self._running = True
         self._worker = threading.Thread(target=self._worker_loop,
                                         name="serve-batcher", daemon=True)
         self._worker.start()
         register_serving_surface("gateway", self.surface_snapshot)
         return self
+
+    def warmup(self, input_shapes: Optional[List[tuple]] = None) -> int:
+        """Compile (and execute once, on zeros) every (bucket, input
+        shape) executable so no served request ever pays a compile.
+        Returns the number of NEW executables compiled; the cumulative
+        count exports as the ``geomx_serve_warmup_compiles`` gauge.
+        The cache bound is untouched — warmup populates exactly the
+        same bounded (bucket, shape) key set the serving path would."""
+        shapes = [tuple(int(d) for d in s)
+                  for s in (input_shapes
+                            if input_shapes is not None
+                            else self.warmup_shapes)]
+        named = self.replica.params()
+        if not shapes or not named:
+            return 0
+        compiles = 0
+        for shape in shapes:
+            for b in self.buckets:
+                key = (int(b),) + shape
+                fresh = key not in self._jit_cache
+                fn = self._forward_fn(b, shape)
+                xb = np.zeros((int(b),) + shape, np.float32)
+                np.asarray(fn(named, xb))   # block: the compile (and
+                #                             first run) happens HERE
+                if fresh:
+                    compiles += 1
+        self.warmup_compiles += compiles
+        try:
+            from geomx_tpu.telemetry.registry import get_registry
+            get_registry().gauge(
+                "geomx_serve_warmup_compiles",
+                "Bucket executables compiled up front by gateway "
+                "warmup").set(float(self.warmup_compiles))
+        except Exception:
+            pass
+        return compiles
 
     def stop(self) -> None:
         self._running = False
@@ -218,10 +296,12 @@ class InferenceGateway:
 
     # ---- submission --------------------------------------------------------
 
-    def submit(self, x: np.ndarray) -> _Request:
+    def submit(self, x: np.ndarray,
+               transport: str = "local") -> _Request:
         """Enqueue one example.  A full queue or an active shed marks
         the request shed immediately (explicit refusal, never silent
-        loss)."""
+        loss).  ``transport`` labels the request's ledger record with
+        the lane it arrived on (``http`` / ``native`` / ``local``)."""
         with self._lock:
             self._rid += 1
             rid = self._rid
@@ -231,7 +311,8 @@ class InferenceGateway:
                 if self._shed_acc >= 1.0:
                     self._shed_acc -= 1.0
                     shed = True
-        req = _Request(np.asarray(x, np.float32), rid)
+        req = _Request(np.asarray(x, np.float32), rid,
+                       transport=transport)
         if shed:
             self._finish_shed(req)
             return req
@@ -271,31 +352,60 @@ class InferenceGateway:
                              reply_s=0.0)
         return True
 
-    # ---- the continuous-batching worker ------------------------------------
+    # ---- the pipelined continuous-batching worker --------------------------
 
     def _worker_loop(self) -> None:
-        while self._running:
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
+        """Double-buffered dispatch (the GEOMX_PREFETCH pattern): jax
+        dispatch is asynchronous, so ``_dispatch_async`` returns while
+        batch *t* still runs on device; the worker immediately drains
+        and assembles batch *t+1*, and only then blocks on *t*'s result
+        in ``_finalize`` — host batch assembly hides behind device
+        compute.  With nothing queued, an in-flight batch finalizes
+        immediately (no latency tax at light load)."""
+        pending = None      # (batch, out_device, t_f0) in flight
+        stopping = False
+        while self._running and not stopping:
+            if pending is None:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            else:
+                try:
+                    first = self._queue.get_nowait()
+                except queue.Empty:
+                    self._finalize(*pending)
+                    pending = None
+                    continue
             if first is None:
-                return
+                break
             batch = [first]
+            # deadline-or-full coalescing: a full batch closes the
+            # moment it fills; while a batch is already in flight the
+            # device is the clock — absorb whatever is queued right
+            # now without sleeping out the window
             deadline = time.monotonic() + self.queue_ms / 1000.0
             while len(batch) < self.max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
                 try:
-                    nxt = self._queue.get(timeout=remaining)
+                    if pending is not None:
+                        nxt = self._queue.get_nowait()
+                    else:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
                 if nxt is None:
-                    self._dispatch(batch)
-                    return
+                    stopping = True
+                    break
                 batch.append(nxt)
-            self._dispatch(batch)
+            new_pending = self._dispatch_async(batch)
+            if pending is not None:
+                self._finalize(*pending)
+            pending = new_pending
+        if pending is not None:
+            self._finalize(*pending)
         # drain on stop: whatever is queued still gets an answer
         while True:
             try:
@@ -303,7 +413,9 @@ class InferenceGateway:
             except queue.Empty:
                 return
             if req is not None:
-                self._dispatch([req])
+                done = self._dispatch_async([req])
+                if done is not None:
+                    self._finalize(*done)
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -316,7 +428,11 @@ class InferenceGateway:
 
     def _forward_fn(self, bucket: int, feat_shape: tuple):
         """The jit'd forward for one padded bucket size (bounded cache:
-        one executable per (bucket, input feature shape))."""
+        one executable per (bucket, input feature shape)).  Off-CPU the
+        padded input buffer is donated — the gateway's ping-pong host
+        buffers never read a dispatched batch back, so the device copy
+        is dead weight the executable may reuse; on CPU donation is
+        skipped (unusable there, and jax warns per call)."""
         key = (int(bucket),) + tuple(feat_shape)
         fn = self._jit_cache.get(key)
         if fn is not None:
@@ -340,11 +456,45 @@ class InferenceGateway:
                 variables = unflatten_params(self.treedef, named_params)
                 return model.apply(variables, xb, train=False)
 
-        fn = jax.jit(fwd)
+        if jax.default_backend() != "cpu":
+            fn = jax.jit(fwd, donate_argnums=(1,))
+        else:
+            fn = jax.jit(fwd)
         self._jit_cache[key] = fn
         return fn
 
-    def _dispatch(self, batch: List[_Request]) -> None:
+    def _assemble(self, bucket: int, batch: List[_Request],
+                  feat_shape: tuple) -> np.ndarray:
+        """Copy the batch into a persistent pre-allocated padded bucket
+        buffer: one row copy per request, pad rows zeroed — never a
+        per-batch ``np.stack`` + ``np.concatenate`` allocation pair.
+        Buffers ping-pong per (bucket, shape): the previous batch's
+        host->device transfer may still be in flight on its buffer
+        while this one fills the other."""
+        key = (int(bucket),) + tuple(feat_shape)
+        bufs = self._host_bufs.get(key)
+        if bufs is None:
+            bufs = [np.zeros((int(bucket),) + tuple(feat_shape),
+                             np.float32) for _ in range(2)]
+            self._host_bufs[key] = bufs
+            self._buf_flip[key] = 0
+        flip = self._buf_flip[key] ^ 1
+        self._buf_flip[key] = flip
+        buf = bufs[flip]
+        n = len(batch)
+        for i, r in enumerate(batch):
+            buf[i] = r.x        # raises on a shape mismatch -> error
+            #                     fan-out upstream, same as np.stack did
+        if n < bucket:
+            buf[n:] = 0.0
+        return buf
+
+    def _dispatch_async(self, batch: List[_Request]):
+        """Claim + assemble + dispatch one batch; returns the in-flight
+        ``(batch, out_device, t_f0)`` triple for ``_finalize`` — jax
+        async dispatch means the device result is a future, not a
+        value.  None = nothing survived claiming or the dispatch itself
+        failed (already error-finished)."""
         # claim each request first: one that timed out while queued was
         # already finished (500 + "timeout" accounting) by the HTTP
         # thread — running it anyway would count it "ok" after the
@@ -352,8 +502,8 @@ class InferenceGateway:
         batch = [r for r in batch if r.take()]
         if not batch:
             self._observe_queue_depth()
-            return
-        t_batch = time.time()
+            return None
+        t_batch = time.monotonic()
         n = len(batch)
         bucket = self.bucket_for(n)
         for r in batch:
@@ -361,22 +511,29 @@ class InferenceGateway:
             r.batch_size = n
             r.bucket = bucket
         try:
-            xb = np.stack([r.x for r in batch]).astype(np.float32)
-            if bucket > n:
-                pad = np.zeros((bucket - n,) + xb.shape[1:], np.float32)
-                xb = np.concatenate([xb, pad], axis=0)
+            feat_shape = tuple(np.shape(batch[0].x))
+            xb = self._assemble(bucket, batch, feat_shape)
             named = self.replica.params()
-            fn = self._forward_fn(bucket, xb.shape[1:])
-            t_f0 = time.time()
-            out = np.asarray(fn(named, xb))
-            forward_s = time.time() - t_f0
+            fn = self._forward_fn(bucket, feat_shape)
+            t_f0 = time.monotonic()
+            return (batch, fn(named, xb), t_f0)
+        except Exception as e:
+            self._finish_error(batch, e)
+            return None
+
+    def _finalize(self, batch: List[_Request], out_dev, t_f0) -> None:
+        """Block on an in-flight batch's device result and fan out the
+        replies + terminal accounting."""
+        try:
+            out = np.asarray(out_dev)       # the block point
+            forward_s = time.monotonic() - t_f0
             self.batches_dispatched += 1
-            self._observe_batch(n)
-            t_reply0 = time.time()
+            self._observe_batch(len(batch))
+            t_reply0 = time.monotonic()
             for i, r in enumerate(batch):
                 r.result = out[i]
                 r.event.set()
-            reply_s = time.time() - t_reply0
+            reply_s = time.monotonic() - t_reply0
             for r in batch:
                 self.requests_ok += 1
                 self._count_request("ok")
@@ -384,15 +541,19 @@ class InferenceGateway:
                                      forward_s=forward_s,
                                      reply_s=reply_s)
         except Exception as e:
-            for r in batch:
-                r.error = repr(e)
-                r.event.set()
-                self.requests_error += 1
-                self._count_request("error")
-                self._ledger_observe(r, status="error", forward_s=0.0,
-                                     reply_s=0.0)
+            self._finish_error(batch, e)
         self._observe_queue_depth()
         self._observe_staleness()
+
+    def _finish_error(self, batch: List[_Request], e: Exception) -> None:
+        for r in batch:
+            r.error = repr(e)
+            r.event.set()
+            self.requests_error += 1
+            self._count_request("error")
+            self._ledger_observe(r, status="error", forward_s=0.0,
+                                 reply_s=0.0)
+        self._observe_queue_depth()
 
     # ---- telemetry ---------------------------------------------------------
 
@@ -444,16 +605,31 @@ class InferenceGateway:
             from geomx_tpu.telemetry.ledger import get_request_ledger
             t_batch = req.t_batch if req.t_batch is not None \
                 else req.t_enqueue
+            # queue_s from the monotonic pair; the record's anchor
+            # stays wall clock (the one place wall time belongs)
             get_request_ledger().observe(
-                rid=req.rid, t_enqueue=req.t_enqueue,
+                rid=req.rid, t_enqueue=req.t_enqueue_unix,
                 queue_s=max(0.0, t_batch - req.t_enqueue),
                 forward_s=forward_s, reply_s=reply_s,
                 batch_size=req.batch_size, bucket=req.bucket,
-                status=status)
+                status=status, transport=req.transport)
         except Exception:
             pass
 
     # ---- surfaces ----------------------------------------------------------
+
+    def wait_requests(self, reqs: List[_Request]) -> None:
+        """Wait a submitted group out under ONE shared client deadline
+        (both the HTTP door and the native lane use this): a request
+        still unanswered at the deadline is claimed as a timeout —
+        unless a batch worker claimed it first, in which case the
+        result is imminent and fabricating a timeout would race the
+        ok-accounting."""
+        deadline = time.monotonic() + self.request_timeout_s
+        for r in reqs:
+            if not r.event.wait(max(0.0, deadline - time.monotonic())):
+                if not self._finish_timeout(r):
+                    r.event.wait(self.request_timeout_s)
 
     def surface_snapshot(self) -> dict:
         """The ``/healthz`` serving block: published versions the
@@ -465,6 +641,7 @@ class InferenceGateway:
                 "request_timeout_s": self.request_timeout_s,
                 "buckets": list(self.buckets),
                 "jit_cache_size": self.jit_cache_size(),
+                "warmup_compiles": self.warmup_compiles,
                 "shed_fraction": self.shed_fraction(),
                 "requests": {"ok": self.requests_ok,
                              "shed": self.requests_shed,
@@ -484,15 +661,9 @@ class InferenceGateway:
             return (400, json.dumps(
                 {"error": f"bad request: {e!r}"}).encode("utf-8"),
                 "application/json")
-        reqs = [self.submit(x) for x in xs]
-        deadline = time.monotonic() + self.request_timeout_s
-        for r in reqs:
-            if not r.event.wait(max(0.0, deadline - time.monotonic())):
-                if not self._finish_timeout(r):
-                    # a worker claimed it mid-forward: the result is
-                    # imminent — wait it out rather than race the
-                    # ok-accounting with a fabricated timeout
-                    r.event.wait(self.request_timeout_s)
+        self._account_wire("http", "rx", len(body))
+        reqs = [self.submit(x, transport="http") for x in xs]
+        self.wait_requests(reqs)
         if any(r.error == "shed" for r in reqs):
             return (503, json.dumps(
                 {"error": "shed", "shed": sum(1 for r in reqs
@@ -507,7 +678,18 @@ class InferenceGateway:
                "version": self.replica.version,
                "round": self.replica.last_round(),
                "batch_sizes": [r.batch_size for r in reqs]}
-        return (200, json.dumps(out).encode("utf-8"), "application/json")
+        payload = json.dumps(out).encode("utf-8")
+        self._account_wire("http", "tx", len(payload))
+        return (200, payload, "application/json")
+
+    def _account_wire(self, transport: str, direction: str,
+                      nbytes: int, declared=None) -> None:
+        try:
+            from geomx_tpu.telemetry.ledger import get_request_ledger
+            get_request_ledger().account_wire(transport, direction,
+                                              nbytes, declared=declared)
+        except Exception:
+            pass
 
     def serve_http(self, bind_host: str = "127.0.0.1", port: int = 0):
         """Start the gateway's HTTP surface on the shared exporter:

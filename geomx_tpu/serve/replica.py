@@ -38,6 +38,7 @@ arrays at its own boundary.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -47,6 +48,21 @@ import numpy as np
 from geomx_tpu.compression.sparseagg import (decode_pairs_payload,
                                              densify_pairs_host)
 from geomx_tpu.serve.registry import RegistryClient
+
+
+def _scatter_inplace(flat: np.ndarray, vals: np.ndarray,
+                     idx: np.ndarray) -> None:
+    """In-order pair scatter-add into ``flat`` — np.add.at semantics
+    (sentinels idx<0 dropped, duplicates sum sequentially).  Routed
+    through the nogil native runtime when built; the numpy fallback is
+    bit-identical float32 by construction (same sequential fold)."""
+    try:
+        from geomx_tpu.runtime.native import scatter_pairs
+        if scatter_pairs(flat, vals, idx) is not None:
+            return
+    except (ImportError, ValueError):
+        pass
+    densify_pairs_host(vals, idx, flat.size, out=flat)
 
 
 class ServingReplica:
@@ -62,11 +78,23 @@ class ServingReplica:
         self._layer_rounds: Dict[str, int] = {}     # layer -> last applied
         self._last_round = 0
         self._gen: Optional[int] = None
-        self._refresh_unix = 0.0
+        self._refresh_mono = 0.0     # monotonic: wall steps must not
+        #                              corrupt the staleness bound
+        # O(k) refresh fast path (docs/serving.md "Serving fast path"):
+        # the flat buffer WE allocated backing the published view (None
+        # when the layer came straight from a base install — that array
+        # may alias a read-only wire buffer we must never scatter into),
+        # and the retired previous buffer, which lags the published
+        # value by EXACTLY the one delta recorded next to it.
+        self._pub_flat: Dict[str, Optional[np.ndarray]] = {}
+        self._spare: Dict[str, Tuple[np.ndarray, np.ndarray,
+                                     np.ndarray]] = {}
         self.refreshes = 0
         self.deltas_applied = 0
         self.replays_deduped = 0
         self.restarts_detected = 0
+        self.o1_applies = 0          # O(k) scatter-into-spare refreshes
+        self.dense_copies = 0        # O(n) copy fallbacks
 
     # ---- feeds -------------------------------------------------------------
 
@@ -82,28 +110,76 @@ class ServingReplica:
                 self._order[order] = layer
             self._params = dict(self._params)       # copy-on-write swap
             self._params[layer] = np.ascontiguousarray(arr)
+            # the base may alias the (read-only) wire buffer: not ours
+            # to scatter into, and any retired spare is now stale
+            self._pub_flat[layer] = None
+            self._spare.pop(layer, None)
             self._layer_rounds.setdefault(layer, 0)
-            self._refresh_unix = time.time()
+            self._refresh_mono = time.monotonic()
 
     def apply_delta(self, layer: str, round_id: int, vals: np.ndarray,
                     idx: np.ndarray) -> bool:
-        """One pair delta onto a copy of the layer, then swap.  False =
-        deduped replay (already applied, nothing changed)."""
+        """One pair delta onto a private copy of the layer, then swap.
+        False = deduped replay (already applied, nothing changed).
+
+        The hot path is O(k), not O(n): every publish retires the
+        previous flat buffer next to the one delta it is missing, so
+        the NEXT apply replays that single delta into the retired
+        buffer (O(k)), scatters the new delta (O(k)), and republishes
+        it — two buffers ping-pong per layer, no per-delta dense copy.
+        Safety gate: the retired buffer is reused only when its
+        refcount proves no reader still holds the old params dict (a
+        forward pass mid-batch, a snapshot in a test) — otherwise this
+        apply falls back to the O(n) dense copy, counted in
+        ``dense_copies``.  Both paths run the identical sequence of
+        in-order float32 scatter-adds, so the served weights are
+        bit-exact against a dense checkpoint either way."""
         with self._lock:
             if (layer, int(round_id)) in self._applied:
                 self.replays_deduped += 1
                 return False
             cur = self._params[layer]
-            flat = cur.reshape(-1).copy()
-            densify_pairs_host(vals, idx, flat.size, out=flat)
+            vals = np.ascontiguousarray(vals, np.float32).reshape(-1)
+            idx = np.ascontiguousarray(idx, np.int64).reshape(-1)
+            if idx.size and int(idx.max()) >= cur.size:
+                raise ValueError(
+                    f"delta index {int(idx.max())} out of range for "
+                    f"size-{cur.size} layer {layer!r}")
+            new_flat = None
+            sp = self._spare.pop(layer, None)
+            if sp is not None:
+                flat, mv, mi = sp
+                # refs on flat right now: the sp tuple, the local name,
+                # and getrefcount's own argument = 3.  Anything above
+                # that is the retired published view (alive inside a
+                # reader-held params dict) still pinning its base —
+                # writing would tear that reader's forward pass.
+                if flat.size == cur.size \
+                        and sys.getrefcount(flat) <= 3:
+                    _scatter_inplace(flat, mv, mi)   # catch up: the one
+                    #                                  delta it missed
+                    _scatter_inplace(flat, vals, idx)
+                    new_flat = flat
+                    self.o1_applies += 1
+                # else: drop the blocked spare — the buffer retired
+                # below replaces it (missing exactly this delta)
+            if new_flat is None:
+                new_flat = cur.reshape(-1).copy()
+                _scatter_inplace(new_flat, vals, idx)
+                self.dense_copies += 1
+            prev = self._pub_flat.get(layer)
+            if prev is not None and prev.size == cur.size \
+                    and prev is not new_flat:
+                self._spare[layer] = (prev, vals.copy(), idx.copy())
+            self._pub_flat[layer] = new_flat
             self._params = dict(self._params)
-            self._params[layer] = flat.reshape(cur.shape)
+            self._params[layer] = new_flat.reshape(cur.shape)
             self._applied.add((layer, int(round_id)))
             self._layer_rounds[layer] = max(
                 self._layer_rounds.get(layer, 0), int(round_id))
             self._last_round = max(self._last_round, int(round_id))
             self.deltas_applied += 1
-            self._refresh_unix = time.time()
+            self._refresh_mono = time.monotonic()
             return True
 
     def sync(self, client: RegistryClient) -> dict:
@@ -147,7 +223,7 @@ class ServingReplica:
                     and gen != prev_gen:
                 self.restarts_detected += 1
             self._gen = gen
-            self._refresh_unix = time.time()
+            self._refresh_mono = time.monotonic()
             self.refreshes += 1
         return {"frames": len(frames), "applied": applied,
                 "deduped": deduped, "gen": gen,
@@ -177,17 +253,22 @@ class ServingReplica:
             return self._gen
 
     def staleness_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the last successful refresh, on the MONOTONIC
+        clock (``now``, when given, must be a ``time.monotonic()``
+        instant) — an NTP wall-clock step mid-run must not fake a
+        freshness violation or mask a real one."""
         with self._lock:
-            if not self._refresh_unix:
+            if not self._refresh_mono:
                 return float("inf")
-            return max(0.0, (time.time() if now is None else now)
-                       - self._refresh_unix)
+            return max(0.0, (time.monotonic() if now is None else now)
+                       - self._refresh_mono)
 
     def snapshot(self) -> dict:
         """The ``/healthz`` serving-surface row for this replica."""
         with self._lock:
-            staleness = (float("inf") if not self._refresh_unix
-                         else max(0.0, time.time() - self._refresh_unix))
+            staleness = (float("inf") if not self._refresh_mono
+                         else max(0.0,
+                                  time.monotonic() - self._refresh_mono))
             return {"version": self.version, "party": self.party,
                     "layers": len(self._params),
                     "last_round": self._last_round,
@@ -197,4 +278,6 @@ class ServingReplica:
                     "refreshes": self.refreshes,
                     "deltas_applied": self.deltas_applied,
                     "replays_deduped": self.replays_deduped,
-                    "restarts_detected": self.restarts_detected}
+                    "restarts_detected": self.restarts_detected,
+                    "o1_applies": self.o1_applies,
+                    "dense_copies": self.dense_copies}

@@ -56,7 +56,7 @@ import threading
 import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -266,6 +266,10 @@ class MsgType(enum.IntEnum):
                          # (reference ASK1 reply, van.cc:1238-1296)
     RELAY = 14           # node -> node partial-aggregate transfer
                          # (reference TS_Process merge path, kv_app.h:1520)
+    INFER = 15           # serving fast path: client -> gateway inference
+                         # batch (rows x feat fp32; docs/serving.md
+                         # "Serving fast path")
+    INFER_REPLY = 16     # gateway -> client outputs (or an error meta)
 
 
 # graftlint: disable=GX-WIRE-001 — legacy-compat v0x01 header decode only
@@ -1158,15 +1162,23 @@ def _log_msg(direction: str, msg: Msg, nbytes: int) -> None:
           f"bytes={nbytes}", file=sys.stderr, flush=True)
 
 
-def send_frame(sock: socket.socket, msg: Msg) -> None:
+def send_frame(sock: socket.socket, msg: Msg) -> int:
+    """Encode + ship one frame; returns the total on-wire byte count
+    (length prefix included) so callers doing byte-true accounting —
+    the serving fast path's RequestLedger — measure what actually
+    crossed the socket."""
     data = maybe_corrupt_frame(msg, msg.encode())
     sock.sendall(_LEN.pack(len(data)) + data)
     wire_stats.add_sent(len(data) + 4)
     if _verbose_level() >= 2:
         _log_msg("SEND", msg, len(data))
+    return len(data) + 4
 
 
-def recv_frame(sock: socket.socket) -> Optional[Msg]:
+def recv_frame_sized(sock: socket.socket) -> Optional[Tuple[Msg, int]]:
+    """:func:`recv_frame` plus the received frame's on-wire byte count
+    (length prefix included) — the rx half of the byte-true accounting
+    the serving fast path's RequestLedger does per request."""
     head = _recv_exact(sock, 4)
     if head is None:
         return None
@@ -1194,7 +1206,12 @@ def recv_frame(sock: socket.socket) -> Optional[Msg]:
     msg = Msg.decode(data)
     if _verbose_level() >= 2:
         _log_msg("RECV", msg, n)
-    return msg
+    return msg, n + 4
+
+
+def recv_frame(sock: socket.socket) -> Optional[Msg]:
+    got = recv_frame_sized(sock)
+    return None if got is None else got[0]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

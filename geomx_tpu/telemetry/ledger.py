@@ -646,10 +646,18 @@ class RequestLedger:
         self._records: "collections.deque" = \
             collections.deque(maxlen=self.capacity)
         self.observed_total = 0
+        # byte-true wire accounting per transport lane ("native" /
+        # "http"): actual on-wire bytes (frame length prefixes
+        # included) vs the sender-declared payload bytes — the same
+        # honesty discipline the RoundLedger applies to gradient
+        # frames, here for inference traffic (docs/serving.md
+        # "Serving fast path").
+        self._wire: Dict[str, Dict[str, int]] = {}
 
     def observe(self, rid: int, *, t_enqueue: float, queue_s: float,
                 forward_s: float, reply_s: float, batch_size: int,
-                bucket: int, status: str = "ok") -> None:
+                bucket: int, status: str = "ok",
+                transport: Optional[str] = None) -> None:
         rec = {"rid": int(rid), "t_enqueue": float(t_enqueue),
                "queue_s": float(queue_s), "forward_s": float(forward_s),
                "reply_s": float(reply_s),
@@ -657,9 +665,38 @@ class RequestLedger:
                + float(reply_s),
                "batch_size": int(batch_size), "bucket": int(bucket),
                "status": str(status)}
+        if transport is not None:
+            rec["transport"] = str(transport)
         with self._lock:
             self._records.append(rec)
             self.observed_total += 1
+
+    def account_wire(self, transport: str, direction: str, nbytes: int,
+                     declared: Optional[int] = None) -> None:
+        """One inference frame's on-wire bytes (``direction`` is
+        ``"rx"`` or ``"tx"``).  ``declared`` is what the sender claimed
+        for the payload; actual/declared is the honesty ratio
+        `summary()` reports — PER DIRECTION, because the two directions
+        have structurally different payload sizes (a feature batch in,
+        a logits row out): the ≤ 1.02 acceptance bound applies to the
+        payload-dominant request direction, where frame overhead
+        amortizes over real payload bytes, while a tiny reply payload
+        under a fixed frame header is reported, not gated (no wire
+        format can frame 80 bytes inside 2% overhead)."""
+        with self._lock:
+            lane = self._wire.setdefault(str(transport), {
+                "rx_bytes": 0, "tx_bytes": 0, "frames": 0,
+                "rx_declared": 0, "rx_declared_actual": 0,
+                "tx_declared": 0, "tx_declared_actual": 0})
+            lane[f"{direction}_bytes"] = \
+                lane.get(f"{direction}_bytes", 0) + int(nbytes)
+            lane["frames"] += 1
+            if declared is not None and int(declared) > 0:
+                lane[f"{direction}_declared"] = \
+                    lane.get(f"{direction}_declared", 0) + int(declared)
+                lane[f"{direction}_declared_actual"] = \
+                    lane.get(f"{direction}_declared_actual", 0) \
+                    + int(nbytes)
 
     def records(self) -> List[dict]:
         with self._lock:
@@ -672,12 +709,27 @@ class RequestLedger:
         with self._lock:
             recs = list(self._records)
             total = self.observed_total
+            wire = {t: dict(lane) for t, lane in self._wire.items()}
         out: Dict[str, Any] = {"requests": len(recs),
                                "observed_total": total}
         by_status: Dict[str, int] = {}
+        by_transport: Dict[str, int] = {}
         for r in recs:
             by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+            t = r.get("transport")
+            if t is not None:
+                by_transport[t] = by_transport.get(t, 0) + 1
         out["by_status"] = by_status
+        if by_transport:
+            out["by_transport"] = by_transport
+        if wire:
+            for lane in wire.values():
+                for d in ("rx", "tx"):
+                    decl = lane.get(f"{d}_declared", 0)
+                    lane[f"honesty_ratio_{d}"] = (
+                        round(lane[f"{d}_declared_actual"] / decl, 4)
+                        if decl > 0 else None)
+            out["wire"] = wire
         ok = [r for r in recs if r["status"] == "ok"]
         for phase in REQUEST_PHASES + ("total",):
             vals = sorted(r[f"{phase}_s"] for r in ok)

@@ -684,4 +684,25 @@ int64_t gx_merge_pairs(const float* vals, const int64_t* idx, int64_t n,
   return m;
 }
 
+// Sparse pair scatter-add (serve/replica.py O(k) refresh fast path):
+// out[idx[i]] += vals[i] for each pair IN ORDER, skipping sentinels
+// (idx < 0) — exactly numpy's unbuffered np.add.at fold, so the
+// native and Python apply paths are bit-identical float32 by
+// construction.  Bounds are checked BEFORE any write (a delta with an
+// out-of-range index must not half-apply); returns the number of
+// pairs applied, or -1 on a bounds violation with out untouched.
+int64_t gx_scatter_pairs(float* out, int64_t n, const float* vals,
+                         const int64_t* idx, int64_t k) {
+  for (int64_t i = 0; i < k; ++i)
+    if (idx[i] >= n) return -1;
+  int64_t applied = 0;
+  for (int64_t i = 0; i < k; ++i) {
+    const int64_t ix = idx[i];
+    if (ix < 0) continue;
+    out[ix] += vals[i];
+    ++applied;
+  }
+  return applied;
+}
+
 }  // extern "C"

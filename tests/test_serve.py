@@ -315,7 +315,8 @@ def test_replica_staleness_tracking():
     assert rep.staleness_s() == float("inf")
     assert rep.snapshot()["staleness_s"] is None
     rep.install_base("0000/w", np.zeros(4, np.float32), order=0)
-    assert rep.staleness_s(rep._refresh_unix + 2.5) == pytest.approx(2.5)
+    # freshness is monotonic-clock: a wall step cannot corrupt it
+    assert rep.staleness_s(rep._refresh_mono + 2.5) == pytest.approx(2.5)
     assert rep.snapshot()["staleness_s"] is not None
 
 
@@ -495,6 +496,30 @@ def test_request_ledger_tracks_status():
     assert s["queue_p99_s"] == pytest.approx(0.01)
 
 
+def test_request_ledger_wire_lanes_per_direction_honesty():
+    """account_wire keeps per-transport rx/tx byte lanes with the
+    honesty ratio PER DIRECTION: a request lane at 1% framing overhead
+    must not be masked (or indicted) by tiny header-dominated replies
+    sharing the transport."""
+    led = RequestLedger(capacity=16)
+    led.account_wire("native", "rx", 1010, declared=1000)
+    led.account_wire("native", "tx", 200, declared=100)
+    led.account_wire("native", "rx", 50)            # undeclared frame
+    led.account_wire("http", "rx", 300)
+    s = led.summary()
+    lane = s["wire"]["native"]
+    assert lane["rx_bytes"] == 1060 and lane["tx_bytes"] == 200
+    assert lane["frames"] == 3
+    # undeclared frames count bytes but never enter the honesty ratio
+    assert lane["rx_declared"] == 1000
+    assert lane["rx_declared_actual"] == 1010
+    assert lane["honesty_ratio_rx"] == pytest.approx(1.01)
+    assert lane["honesty_ratio_tx"] == pytest.approx(2.0)
+    http = s["wire"]["http"]
+    assert http["honesty_ratio_rx"] is None         # nothing declared
+    assert http["rx_bytes"] == 300
+
+
 # --------------------------------------------------------------------------
 # SLO policy
 # --------------------------------------------------------------------------
@@ -645,12 +670,16 @@ def test_serve_knobs_from_env(monkeypatch):
     monkeypatch.setenv("GEOMX_SERVE_QUEUE_MS", "7.5")
     monkeypatch.setenv("GEOMX_SERVE_STALENESS_S", "30")
     monkeypatch.setenv("GEOMX_SERVE_TIMEOUT_S", "12.5")
+    monkeypatch.setenv("GEOMX_SERVE_WARMUP", "0")
+    monkeypatch.setenv("GEOMX_SERVE_NATIVE_WIRE", "0")
     cfg = GeoConfig.from_env()
     assert cfg.serve_port == 9090
     assert cfg.serve_max_batch == 32
     assert cfg.serve_queue_ms == 7.5
     assert cfg.serve_staleness_s == 30.0
     assert cfg.serve_timeout_s == 12.5
+    assert cfg.serve_warmup is False
+    assert cfg.serve_native_wire is False
     # the gateway's default request deadline comes from the same knob
     rep = ServingReplica("v1")
     gw = InferenceGateway(rep, treedef=None,
@@ -682,14 +711,17 @@ def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
                         serve_max_batch=cfg.serve_max_batch,
                         serve_queue_ms=cfg.serve_queue_ms,
                         serve_staleness_s=cfg.serve_staleness_s,
-                        serve_timeout_s=cfg.serve_timeout_s)
+                        serve_timeout_s=cfg.serve_timeout_s,
+                        serve_warmup=cfg.serve_warmup,
+                        serve_native_wire=cfg.serve_native_wire)
         return Trainer(MLP(num_classes=10, hidden=(32,)), topo,
                        optax.sgd(0.1), sync=get_sync_algorithm(cfg),
                        config=cfg, donate=False)
 
     for var in ("GEOMX_SERVE_PORT", "GEOMX_SERVE_MAX_BATCH",
                 "GEOMX_SERVE_QUEUE_MS", "GEOMX_SERVE_STALENESS_S",
-                "GEOMX_SERVE_TIMEOUT_S"):
+                "GEOMX_SERVE_TIMEOUT_S", "GEOMX_SERVE_WARMUP",
+                "GEOMX_SERVE_NATIVE_WIRE"):
         monkeypatch.delenv(var, raising=False)
     tr = build()
     rng = np.random.RandomState(0)
@@ -706,6 +738,8 @@ def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
     monkeypatch.setenv("GEOMX_SERVE_QUEUE_MS", "9.0")
     monkeypatch.setenv("GEOMX_SERVE_STALENESS_S", "1.0")
     monkeypatch.setenv("GEOMX_SERVE_TIMEOUT_S", "5.0")
+    monkeypatch.setenv("GEOMX_SERVE_WARMUP", "0")
+    monkeypatch.setenv("GEOMX_SERVE_NATIVE_WIRE", "0")
     tr2 = build()
     j_serving = canonicalize_jaxpr(
         str(jax.make_jaxpr(tr2.train_step)(state, xb, yb)))
@@ -768,3 +802,171 @@ def test_train_while_serving_delta_refresh_bit_exact(tmp_path):
         replica_cli.close()
         srv.stop()
         srv.join(5.0)
+
+
+# --------------------------------------------------------------------------
+# serving fast path (docs/serving.md "Serving fast path")
+# --------------------------------------------------------------------------
+
+def test_gateway_prewarm_compiles_before_first_request():
+    """start() compiles every (bucket, input shape) executable up
+    front; serving any batch size afterwards adds ZERO compiles — the
+    jit cache holds exactly what warmup built (the r01 p99/p50 gap was
+    first-request compiles landing inside request latency)."""
+    gw, rep, W = _matmul_gateway(max_batch=8)
+    gw.warmup_shapes = [(6,)]
+    gw._warmup_enabled = True
+    gw.start()
+    try:
+        assert gw.warmup_compiles == len(gw.buckets) == 4
+        assert gw.jit_cache_size() == gw.warmup_compiles
+        for n in (1, 3, 5, 8):
+            reqs = [gw.submit(np.full(6, i + 1, np.float32))
+                    for i in range(n)]
+            for r in reqs:
+                assert r.event.wait(30) and r.error is None
+        # the pin: no request paid a compile after warmup
+        assert gw.jit_cache_size() == gw.warmup_compiles
+        assert gw.surface_snapshot()["warmup_compiles"] == 4
+    finally:
+        gw.stop()
+
+
+def test_gateway_concurrent_load_zero_lost_exact_shed():
+    """Concurrent submitters driven through queue_cap pressure: every
+    request resolves to exactly one of ok/shed/timeout (zero silent
+    loss) and the shed counter matches the shed outcomes exactly —
+    the books the zero-lost acceptance gate audits."""
+    rng = np.random.default_rng(3)
+    rep = ServingReplica("v1")
+    W = rng.normal(size=(6, 3)).astype(np.float32)
+    rep.install_base("0000/w", W, order=0)
+    gw = InferenceGateway(
+        rep, treedef=None, max_batch=4, queue_ms=1.0, queue_cap=8,
+        apply_fn=lambda named, xb: xb @ named["0000/w"])
+    gw.start()
+    results = []
+    lock = threading.Lock()
+
+    def loadgen(wid):
+        r = np.random.default_rng(100 + wid)
+        got = []
+        for _ in range(40):
+            req = gw.submit(r.normal(size=6).astype(np.float32))
+            assert req.event.wait(30), "request never resolved"
+            got.append(req.error or "ok")
+        with lock:
+            results.extend(got)
+
+    threads = [threading.Thread(target=loadgen, args=(w,))
+               for w in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+    finally:
+        gw.stop()
+    assert len(results) == 8 * 40          # zero lost: all resolved
+    counts = {k: results.count(k) for k in set(results)}
+    assert set(counts) <= {"ok", "shed", "timeout"}, counts
+    assert counts.get("ok", 0) == gw.requests_ok
+    assert counts.get("shed", 0) == gw.requests_shed
+    assert counts.get("timeout", 0) == gw.requests_timeout
+    assert gw.requests_error == 0
+    assert (gw.requests_ok + gw.requests_shed + gw.requests_timeout
+            == 8 * 40)
+
+
+def test_replica_o1_fast_path_bit_exact_and_counted():
+    """The ping-pong O(k) refresh: after the first two rounds of a
+    layer, applies scatter into the retired spare buffer instead of
+    dense-copying — counted in o1_applies — and the served weights
+    stay bit-exact vs an np.add.at dense checkpoint throughout, even
+    while a reader holds an old snapshot (that costs exactly one dense
+    fallback, never a torn read)."""
+    rng = np.random.default_rng(11)
+    rep = ServingReplica("v1")
+    base = rng.normal(size=(64,)).astype(np.float32)
+    rep.install_base("0000/w", base, order=0)
+    dense = base.copy()
+    held = rep.params()                     # a reader keeps round-0
+    held_copy = {k: v.copy() for k, v in held.items()}
+    for r in range(1, 21):
+        idx = rng.choice(64, size=7, replace=False).astype(np.int64)
+        vals = rng.normal(size=7).astype(np.float32)
+        assert rep.apply_delta("0000/w", r, vals, idx)
+        np.add.at(dense, idx, vals)
+        assert np.array_equal(rep.params()["0000/w"], dense), r
+    # the held snapshot was never scattered into
+    assert np.array_equal(held["0000/w"], held_copy["0000/w"])
+    snap = rep.snapshot()
+    assert snap["o1_applies"] > 0
+    # rounds not covered by the fast path fell back to dense copies —
+    # both paths together account for every apply
+    assert snap["o1_applies"] + snap["dense_copies"] == 20
+
+
+def test_native_wire_roundtrip_and_ledger_accounting():
+    """The native INFER/INFER_REPLY lane end to end: one persistent
+    connection, correct outputs on the same queue as local submits,
+    byte-true rx/tx lanes in the request ledger with the declared-
+    payload honesty ratio bounded on the request direction."""
+    from geomx_tpu.serve.infer_wire import (NativeInferenceClient,
+                                            NativeInferenceServer)
+    from geomx_tpu.telemetry.ledger import get_request_ledger
+    reset_request_ledger()
+    # serving-sized features (the honesty bound is about framing
+    # overhead amortized over REAL payloads, not a 48-byte toy row)
+    gw, rep, W = _matmul_gateway(max_batch=8, dim=784)
+    gw.start()
+    srv = NativeInferenceServer(gw, port=0).start()
+    cli = NativeInferenceClient(("127.0.0.1", srv.port), timeout_s=20.0)
+    try:
+        x = np.arange(2 * 784, dtype=np.float32).reshape(2, 784) / 784.0
+        out = cli.infer(x)
+        assert "error" not in out, out
+        np.testing.assert_allclose(out["outputs"], x @ W, rtol=1e-4)
+        assert out["version"] == "v1"
+        assert len(out["batch_sizes"]) == 2
+        # second frame on the SAME connection (persistent lane)
+        out2 = cli.infer(np.ones((1, 784), np.float32))
+        np.testing.assert_allclose(
+            out2["outputs"], np.ones((1, 784), np.float32) @ W,
+            rtol=1e-4)
+        s = get_request_ledger().summary()
+        assert s["by_transport"].get("native", 0) == 3
+        lane = s["wire"]["native"]
+        assert lane["frames"] == 4          # 2 rx + 2 tx
+        # actual on-wire >= declared payload, within framing overhead
+        assert lane["rx_declared_actual"] >= lane["rx_declared"] > 0
+        assert lane["honesty_ratio_rx"] is not None
+        assert 1.0 <= lane["honesty_ratio_rx"] <= 1.02
+    finally:
+        cli.close()
+        srv.stop()
+        gw.stop()
+
+
+def test_native_wire_shed_is_explicit_reply_not_torn_socket():
+    """A shed on the native lane answers an INFER_REPLY error frame on
+    the same healthy connection — the client sees the refusal and the
+    connection keeps working for the next request."""
+    from geomx_tpu.serve.infer_wire import (NativeInferenceClient,
+                                            NativeInferenceServer)
+    gw, rep, W = _matmul_gateway(max_batch=4)
+    gw.start()
+    srv = NativeInferenceServer(gw, port=0).start()
+    cli = NativeInferenceClient(("127.0.0.1", srv.port), timeout_s=20.0)
+    try:
+        gw.set_shed_fraction(1.0)
+        out = cli.infer(np.ones((2, 6), np.float32))
+        assert out.get("error") == "shed"
+        assert out.get("shed") == 2
+        gw.set_shed_fraction(0.0)
+        ok = cli.infer(np.ones((1, 6), np.float32))
+        assert "outputs" in ok              # same socket still serves
+    finally:
+        cli.close()
+        srv.stop()
+        gw.stop()

@@ -88,6 +88,10 @@ DIRECTION = {
     "host_stall_fraction": "down",
     "serve_qps": "up",
     "serve_p99_ms": "down",
+    "serve_qps_http": "up",
+    "serve_p99_ms_http": "down",
+    "batch_fill_fraction": "up",
+    "native_honesty_ratio": "down",
 }
 
 
@@ -276,15 +280,22 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
                      "staleness_bounded", "zero_lost",
                      "chaos_p99_bounded", "no_double_apply",
                      "jit_cache_bounded", "batch_bounded",
-                     "restart_detected", "slo_shed_decision"):
+                     "restart_detected", "slo_shed_decision",
+                     # r02+ fast-path gates (absent in r01 records)
+                     "prewarm_no_recompile", "native_wire_honest"):
             if gate in rec:
                 out[gate] = bool(rec[gate])
         # machine-sensitive scalars (CPU speed, CI host load); the
-        # band still catches the gateway collapsing
-        if isinstance(rec.get("serve_qps"), (int, float)):
-            out["serve_qps"] = float(rec["serve_qps"])
-        if isinstance(rec.get("serve_p99_ms"), (int, float)):
-            out["serve_p99_ms"] = float(rec["serve_p99_ms"])
+        # band still catches the gateway collapsing.  r02+ adds the
+        # native lane (serve_qps flips to the native headline there —
+        # the one expected step-up the band direction allows), the
+        # http slow door kept as its own series, plus batch fill and
+        # the wire honesty ratio.
+        for k in ("serve_qps", "serve_p99_ms", "serve_qps_http",
+                  "serve_p99_ms_http", "batch_fill_fraction",
+                  "native_honesty_ratio"):
+            if isinstance(rec.get(k), (int, float)):
+                out[k] = float(rec[k])
         return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
