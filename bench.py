@@ -6649,6 +6649,359 @@ def compare_serve_main(argv):
     _emit(_compare_serve(**kwargs))
 
 
+# --------------------------------------------------------------------------
+# --fleetscope: fleet-wide observability acceptance (docs/telemetry.md
+# "Fleetscope") — scheduler-colocated aggregator + gradient-to-inference
+# freshness tracing.  Four gates: (A) train-while-serving on BOTH
+# inference transports with per-round propagation latency (merge ->
+# publish -> apply -> first served) measured as p50/p99; (B) registry
+# kill + failover shows up as a NAMED node-health transition in the
+# fleet document with a bounded propagation spike, while every healthy
+# node's fold degrades gracefully (marked, never fatal); (C) the
+# multi-window burn-rate breach fires deterministically on a seeded
+# latency-inflation chaos series — bit-identical across two same-seed
+# runs; (D) the versioned fleet document serves over GET /fleet and
+# renders through tools/gxtop.py.
+# --------------------------------------------------------------------------
+
+
+def _fleetscope_burn_series(seed, windows="20:4,60:2"):
+    """One deterministic burn-monitor run over a seeded latency-
+    inflation chaos window (virtual time: t = tick index, no clock
+    sampled anywhere) — returns the breach list as canonical JSON so
+    two same-seed runs can be compared byte-for-byte."""
+    import numpy as np
+
+    from geomx_tpu.telemetry.fleetscope import BurnRateMonitor
+
+    rng = np.random.default_rng(seed)
+    mon = BurnRateMonitor(windows=windows, slo_target=0.99)
+    breaches = []
+    for i in range(140):
+        t = float(i)
+        good, bad = 50.0, 0.0
+        if 60 <= i < 95:
+            # seeded chaos: inflated latencies push a seeded fraction
+            # of the tick's traffic over the latency SLO
+            infl = 1.0 + float(rng.random())
+            bad = round(25.0 * infl, 6)
+            good = round(max(0.0, 50.0 - bad), 6)
+        mon.record(t, good, bad)
+        b = mon.evaluate(t)
+        if b is not None:
+            breaches.append(b)
+    return json.dumps(breaches, sort_keys=True), len(breaches)
+
+
+def _compare_fleetscope(rounds: int = 6, clients: int = 2,
+                        rows_per_req: int = 2, max_batch: int = 8,
+                        queue_ms: float = 2.0, delta_frac: float = 0.01,
+                        seed: int = 0, out_dir=None):
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from geomx_tpu.config import GeoConfig
+    from geomx_tpu.models import get_model
+    from geomx_tpu.serve.gateway import (InferenceGateway, flatten_params)
+    from geomx_tpu.serve.infer_wire import serve_native
+    from geomx_tpu.serve.registry import RegistryClient, RegistryServer
+    from geomx_tpu.serve.replica import ServingReplica
+    from geomx_tpu.service.scheduler import GeoScheduler, SchedulerClient
+    from geomx_tpu.telemetry.fleetscope import (
+        get_propagation_tracker, note_propagation,
+        reset_propagation_tracker)
+    from geomx_tpu.telemetry.ledger import (reset_request_ledger,
+                                            reset_round_ledger)
+
+    # arm the scheduler-colocated aggregator BEFORE the scheduler is
+    # constructed (the /fleet route + poll thread attach at metrics-http
+    # start); tight interval + heartbeat so the kill phase resolves in
+    # bench time
+    os.environ["GEOMX_FLEETSCOPE"] = "1"
+    os.environ["GEOMX_FLEETSCOPE_INTERVAL_S"] = "0.25"
+
+    cfg = GeoConfig.from_env()
+    rng = np.random.default_rng(seed)
+    t_bench0 = time.time()
+    out = {"mode": "compare_fleetscope", "rounds": rounds, "seed": seed}
+
+    reset_round_ledger()
+    reset_request_ledger()
+    tracker = reset_propagation_tracker()
+
+    sched = GeoScheduler(heartbeat_timeout=1.5, metrics_port=0).start()
+    out["fleetscope_armed"] = sched.fleetscope is not None
+
+    # ---- model + serving plane (the --serve topology, roster-joined) ----
+    model = get_model("mlp", num_classes=10)
+    feat = 28 * 28
+    variables = model.init(jax.random.PRNGKey(seed),
+                           np.zeros((1, feat), np.float32))
+    named, treedef = flatten_params(variables)
+    named = {k: np.ascontiguousarray(v, np.float32)
+             for k, v in named.items()}
+    dense_ckpt = {k: v.copy() for k, v in named.items()}
+
+    durable_dir = tempfile.mkdtemp(prefix="geomx_fleetscope_registry_")
+    srv = RegistryServer(durable_dir=durable_dir)
+    srv.start()
+    trainer = RegistryClient(srv.addr, sender=0, timeout_s=20.0)
+    trainer.publish("v1", named)
+    replica_cli = RegistryClient(srv.addr, sender=1, timeout_s=20.0)
+    replica = ServingReplica("v1", party=1)
+    replica.sync(replica_cli)
+
+    gw = InferenceGateway(replica, treedef=treedef, model_name="mlp",
+                          num_classes=10, max_batch=max_batch,
+                          queue_ms=queue_ms, warmup_shapes=[(feat,)])
+    gw.start()
+    httpd = gw.serve_http(port=cfg.serve_port)
+    port = httpd.server_address[1]
+    nsrv = serve_native(gw, port=0)
+    out["native_wire_enabled"] = nsrv is not None
+    xs = rng.normal(size=(16, feat)).astype(np.float32)
+
+    # roster joins: the gateway registers as node kind "serve" (its
+    # registered port IS the HTTP surface FleetScope polls); the
+    # registry joins heartbeat-only (port 0 — no HTTP surface), so its
+    # crash becomes a NAMED heartbeat death, not a silent poll gap
+    gw_client = gw.register_with_scheduler(
+        ("127.0.0.1", sched.port), http_port=port,
+        heartbeat_interval_s=0.3)
+    reg_client = SchedulerClient(("127.0.0.1", sched.port))
+    reg_client.register("serve", port=0, tag="registry")
+    reg_client.start_heartbeat(0.3)
+
+    trainer2 = replica_cli2 = failover = None
+    try:
+        # ---- phase A: train-while-serving + propagation join ------------
+        stop_evt = threading.Event()
+        bg_http, bg_native = {}, {}
+        bg = threading.Thread(target=lambda: bg_http.update(
+            _serve_http_load(port, xs, None, clients, rows_per_req,
+                             stop_evt=stop_evt)), daemon=True)
+        bg.start()
+        bg_n = None
+        if nsrv is not None:
+            bg_n = threading.Thread(target=lambda: bg_native.update(
+                _serve_native_load(nsrv.port, xs, None, clients,
+                                   rows_per_req, stop_evt=stop_evt)),
+                daemon=True)
+            bg_n.start()
+
+        def push_round(r, client, rep_client):
+            # the round's "merge" instant: the training plane finished
+            # folding this round (in a full run the RoundLedger's merge
+            # hop lands here — the bench IS the trainer, so it notes
+            # the hop where the merge would be)
+            note_propagation(r, "merge")
+            layers = {}
+            for k, v in dense_ckpt.items():
+                kk = max(1, int(v.size * delta_frac))
+                idx = rng.choice(v.size, size=kk,
+                                 replace=False).astype(np.int64)
+                vals = rng.normal(size=kk).astype(np.float32) * 0.01
+                layers[k] = (vals, idx)
+                np.add.at(v.reshape(-1), idx, vals)
+            client.push_delta("v1", r, layers)
+            replica.sync(rep_client)
+
+        for r in range(1, rounds + 1):
+            push_round(r, trainer, replica_cli)
+            time.sleep(0.2)     # let both doors serve the fresh round
+
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if tracker.summary()["rounds_completed"] >= rounds:
+                break
+            time.sleep(0.1)
+        stop_evt.set()
+        bg.join(30.0)
+        if bg_n is not None:
+            bg_n.join(30.0)
+        prop = tracker.summary()
+        out["propagation"] = prop
+        out["load"] = {"http_ok": bg_http.get("ok", 0),
+                       "native_ok": bg_native.get("ok", 0)}
+        out["propagation_measured"] = bool(
+            prop["rounds_completed"] >= max(1, rounds - 1)
+            and prop["p99_s"] > 0.0)
+        by_lane = prop["by_transport"]
+        out["propagation_both_transports"] = bool(
+            by_lane.get("http", 0) > 0
+            and (nsrv is None or by_lane.get("native", 0) > 0))
+
+        # the fleet document must be live over GET /fleet by now
+        fleet_url = f"http://127.0.0.1:{sched.metrics_port}/fleet"
+        doc = {}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(fleet_url, timeout=5.0) as resp:
+                doc = json.loads(resp.read())
+            if doc.get("fleet_version", 0) > 0 \
+                    and "serve:gateway" in (doc.get("nodes") or {}):
+                break
+            time.sleep(0.2)
+        version_a = int(doc.get("fleet_version", 0))
+        out["fleet_route_ok"] = bool(
+            version_a > 0 and "serve:gateway" in doc.get("nodes", {})
+            and "serve:registry" in doc.get("nodes", {}))
+
+        # ---- phase B: registry kill -> named death + bounded spike ------
+        srv.crash()
+        reg_client.close()      # the dead process stops heartbeating
+        failover = RegistryServer(durable_dir=durable_dir)
+        failover.start()
+        # a DISTINCT sender id: the fresh client's rid counter restarts
+        # at 1, and the journal-restored dedup set already holds
+        # (sender=0, rid) pairs from phase A — same-sender pushes would
+        # be silently deduped as replays
+        trainer2 = RegistryClient(failover.addr, sender=2,
+                                  timeout_s=20.0)
+        replica_cli2 = RegistryClient(failover.addr, sender=1,
+                                      timeout_s=20.0)
+
+        chaos_rounds = [rounds + 1, rounds + 2]
+        for r in chaos_rounds:
+            push_round(r, trainer2, replica_cli2)
+            # a short burst on each door so the failover rounds get a
+            # "served" hop without the continuous load threads
+            _serve_http_load(port, xs, 6, 2, rows_per_req)
+            if nsrv is not None:
+                _serve_native_load(nsrv.port, xs, 6, 2, rows_per_req)
+
+        # the served hop lands on the gateway's batch thread after the
+        # reply fan-out — give the last burst's note a bounded window
+        spike = []
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            spike = [r.get("propagation_s") for r in tracker.rounds()
+                     if r["round"] in chaos_rounds
+                     and "propagation_s" in r]
+            if len(spike) == len(chaos_rounds):
+                break
+            time.sleep(0.1)
+        out["failover_propagation_s"] = spike
+        out["propagation_spike_bounded"] = bool(
+            len(spike) == len(chaos_rounds)
+            and max(spike) < 15.0)
+
+        # the death must surface as a NAMED transition in the document
+        named_death = None
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            doc = sched.fleetscope.document() or {}
+            named_death = next(
+                (t for t in doc.get("transitions", [])
+                 if t.get("node") == "serve:registry"
+                 and t.get("to") == "dead"), None)
+            if named_death is not None:
+                break
+            time.sleep(0.2)
+        out["death_transition"] = named_death
+        out["death_named"] = bool(named_death is not None)
+
+        # degradation: the dead registry is MARKED, every healthy node
+        # keeps folding and the document keeps versioning
+        doc = sched.fleetscope.document() or {}
+        nodes = doc.get("nodes", {})
+        out["degrade_ok"] = bool(
+            nodes.get("serve:registry", {}).get("health") == "dead"
+            and nodes.get("serve:gateway", {}).get("health") == "ok"
+            and doc.get("rollups", {}).get("nodes_dead", 0) >= 1
+            and int(doc.get("fleet_version", 0)) > version_a)
+        out["fleet_document_version"] = int(doc.get("fleet_version", 0))
+
+        # ---- phase C: seeded burn-rate determinism ----------------------
+        run1, n1 = _fleetscope_burn_series(seed)
+        run2, n2 = _fleetscope_burn_series(seed)
+        out["burn"] = {"breaches": n1,
+                       "deterministic": bool(run1 == run2 and n1 == n2)}
+        out["burn_breached"] = bool(n1 >= 1)
+        out["burn_deterministic"] = bool(out["burn"]["deterministic"])
+
+        # ---- artifacts: fleet document + gxtop rendering ----------------
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            fleet_path = os.path.join(out_dir, "fleetscope_fleet.json")
+            with open(fleet_path, "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+            import importlib.util
+            gx_spec = importlib.util.spec_from_file_location(
+                "gxtop", os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "tools", "gxtop.py"))
+            gxtop = importlib.util.module_from_spec(gx_spec)
+            gx_spec.loader.exec_module(gxtop)
+            rendered = gxtop.render(doc)
+            with open(os.path.join(out_dir,
+                                   "fleetscope_gxtop.txt"), "w") as f:
+                f.write(rendered + "\n")
+            out["gxtop_renders"] = bool("serve:gateway" in rendered)
+        else:
+            out["gxtop_renders"] = True
+    finally:
+        for c in (trainer2, replica_cli2, trainer, replica_cli,
+                  gw_client):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        if failover is not None:
+            failover.stop()
+            failover.join(5.0)
+        if nsrv is not None:
+            nsrv.stop()
+        httpd.shutdown()
+        gw.stop()
+        srv.stop()
+        srv.join(5.0)
+        sched.stop()
+        os.environ.pop("GEOMX_FLEETSCOPE", None)
+        os.environ.pop("GEOMX_FLEETSCOPE_INTERVAL_S", None)
+
+    out["propagation_p50_s"] = round(prop["p50_s"], 6)
+    out["propagation_p99_s"] = round(prop["p99_s"], 6)
+    out["elapsed_s"] = round(time.time() - t_bench0, 3)
+    out["ok"] = bool(
+        out.get("fleetscope_armed") and out.get("fleet_route_ok")
+        and out.get("propagation_measured")
+        and out.get("propagation_both_transports")
+        and out.get("death_named")
+        and out.get("propagation_spike_bounded")
+        and out.get("degrade_ok")
+        and out.get("burn_breached") and out.get("burn_deterministic")
+        and out.get("gxtop_renders"))
+    if out_dir:
+        with open(os.path.join(out_dir,
+                               "fleetscope_record.json"), "w") as f:
+            json.dump(out, f, indent=2, default=str)
+        out["artifacts"] = {"out_dir": out_dir}
+    return out
+
+
+def compare_fleetscope_main(argv):
+    kwargs = {}
+    for a in argv:
+        if a.startswith("--rounds="):
+            kwargs["rounds"] = int(a.split("=", 1)[1])
+        elif a.startswith("--clients="):
+            kwargs["clients"] = int(a.split("=", 1)[1])
+        elif a.startswith("--max-batch="):
+            kwargs["max_batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--queue-ms="):
+            kwargs["queue_ms"] = float(a.split("=", 1)[1])
+        elif a.startswith("--delta-frac="):
+            kwargs["delta_frac"] = float(a.split("=", 1)[1])
+        elif a.startswith("--seed="):
+            kwargs["seed"] = int(a.split("=", 1)[1])
+        elif a.startswith("--out-dir="):
+            kwargs["out_dir"] = a.split("=", 1)[1]
+    _emit(_compare_fleetscope(**kwargs))
+
+
 def main():
     if "--compare-kernels" in sys.argv:
         # kernel micro-mode: in-process, single device is enough (no
@@ -6746,6 +7099,13 @@ def main():
         os.environ.setdefault("JAX_PLATFORMS",
                               os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
         compare_serve_main(sys.argv[1:])
+    elif "--fleetscope" in sys.argv:
+        # fleet-wide observability acceptance: the --serve topology
+        # joined to a scheduler roster with the FleetScope aggregator
+        # colocated — same single-device CPU forward, no mesh
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ.get("GEOMX_BENCH_PLATFORM", "cpu"))
+        compare_fleetscope_main(sys.argv[1:])
     elif "--compare-manyparty" in sys.argv:
         # many-party sharded-global-tier acceptance: pure service-plane
         # (sockets + numpy, 16+ worker threads), no jax mesh

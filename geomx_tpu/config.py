@@ -231,6 +231,15 @@ class GeoConfig:
     # lane (the v0x02 TLV frames) next to the HTTP door.
     serve_warmup: bool = True
     serve_native_wire: bool = True
+    # FleetScope (telemetry/fleetscope.py, docs/telemetry.md
+    # "Fleetscope"): fleetscope arms the scheduler-colocated fleet
+    # aggregator (GET /fleet + geomx_fleet_* rollups), polling every
+    # fleetscope_interval_s; fleetscope_burn_windows is the SLO burn-
+    # rate spec as "window_s:threshold" pairs ("60:14,300:6").  Host-
+    # plane only, same jaxpr byte-identity pin as the serve knobs.
+    fleetscope: bool = False
+    fleetscope_interval_s: float = 2.0
+    fleetscope_burn_windows: str = "60:14,300:6"
 
     # ---- resilience (resilience/: membership epochs, degraded-mode sync,
     # deterministic chaos; docs/resilience.md)
@@ -319,6 +328,11 @@ class GeoConfig:
             serve_warmup=_env_bool(["GEOMX_SERVE_WARMUP"], True),
             serve_native_wire=_env_bool(["GEOMX_SERVE_NATIVE_WIRE"],
                                         True),
+            fleetscope=_env_bool(["GEOMX_FLEETSCOPE"], False),
+            fleetscope_interval_s=_env(
+                ["GEOMX_FLEETSCOPE_INTERVAL_S"], 2.0, float),
+            fleetscope_burn_windows=_env(
+                ["GEOMX_FLEETSCOPE_BURN_WINDOWS"], "60:14,300:6", str),
             resilience_residuals=_env(
                 ["GEOMX_RESILIENCE_RESIDUALS"], "reset", str),
             resilience_min_live=_env(

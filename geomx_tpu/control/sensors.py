@@ -54,6 +54,15 @@ class ControlObservation:
     roster_epoch: int = 0
     live_mask: Optional[Tuple[bool, ...]] = None
     num_live: Optional[int] = None
+    # fleet surface (telemetry/fleetscope.py publishing the
+    # geomx_fleet_rollup gauge family): fleet-wide truth so SloPolicy
+    # can steer on the whole fleet, not gateway-local numbers
+    fleet_qps: Optional[float] = None
+    fleet_shed_rate: Optional[float] = None
+    fleet_staleness_max_s: Optional[float] = None
+    fleet_burn_rate: Optional[float] = None
+    fleet_propagation_p99_s: Optional[float] = None
+    fleet_nodes_dead: Optional[int] = None
 
 
 # probe-name -> observation-field mapping for the registry reads
@@ -144,6 +153,18 @@ class ControlSensors:
             compute_fraction=phases.get("compute"),
             host_stall=phases.get("host_stall"),
             **fields)
+        fleet = _gauge_values(reg, "geomx_fleet_rollup")
+        for gkey, field in (("qps", "fleet_qps"),
+                            ("shed_rate", "fleet_shed_rate"),
+                            ("replica_staleness_max_s",
+                             "fleet_staleness_max_s"),
+                            ("burn_rate_max", "fleet_burn_rate"),
+                            ("propagation_p99_s",
+                             "fleet_propagation_p99_s")):
+            if gkey in fleet:
+                obs[field] = float(fleet[gkey])
+        if "nodes_dead" in fleet:
+            obs["fleet_nodes_dead"] = int(fleet["nodes_dead"])
         if self.compute_s_fn is not None:
             obs["compute_s"] = float(self.compute_s_fn(step))
         if self.liveness is not None:

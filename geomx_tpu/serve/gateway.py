@@ -120,7 +120,8 @@ def default_buckets(max_batch: int) -> Tuple[int, ...]:
 class _Request:
     __slots__ = ("x", "event", "result", "error", "rid", "t_enqueue",
                  "t_enqueue_unix", "t_batch", "batch_size", "bucket",
-                 "transport", "_taken_lock", "_taken")
+                 "transport", "model_version", "model_round",
+                 "staleness_s", "_taken_lock", "_taken")
 
     def __init__(self, x: np.ndarray, rid: int,
                  transport: str = "local"):
@@ -137,6 +138,11 @@ class _Request:
         self.batch_size = 0
         self.bucket = 0
         self.transport = transport
+        # freshness provenance, stamped at dispatch from the weight set
+        # the batch actually ran on (None until then / on non-ok ends)
+        self.model_version: Optional[str] = None
+        self.model_round: Optional[int] = None
+        self.staleness_s: Optional[float] = None
         self._taken_lock = threading.Lock()
         self._taken = False
 
@@ -514,6 +520,18 @@ class InferenceGateway:
             feat_shape = tuple(np.shape(batch[0].x))
             xb = self._assemble(bucket, batch, feat_shape)
             named = self.replica.params()
+            # freshness provenance: the version/round/staleness of the
+            # weight set THIS batch runs on, stamped next to the params
+            # read so reply and ledger describe the weights actually
+            # used, not whatever the replica holds at reply time
+            ver = self.replica.version
+            rnd = self.replica.last_round()
+            stale = self.replica.staleness_s()
+            for r in batch:
+                r.model_version = ver
+                r.model_round = rnd
+                r.staleness_s = None if stale == float("inf") \
+                    else float(stale)
             fn = self._forward_fn(bucket, feat_shape)
             t_f0 = time.monotonic()
             return (batch, fn(named, xb), t_f0)
@@ -540,6 +558,17 @@ class InferenceGateway:
                 self._ledger_observe(r, status="ok",
                                      forward_s=forward_s,
                                      reply_s=reply_s)
+            # propagation join's terminal hop: this batch served its
+            # round, per transport (the tracker keeps only the first)
+            try:
+                from geomx_tpu.telemetry.fleetscope import \
+                    note_propagation
+                for r in batch:
+                    if r.model_round:
+                        note_propagation(r.model_round, "served",
+                                         transport=r.transport)
+            except Exception:
+                pass
         except Exception as e:
             self._finish_error(batch, e)
         self._observe_queue_depth()
@@ -612,7 +641,10 @@ class InferenceGateway:
                 queue_s=max(0.0, t_batch - req.t_enqueue),
                 forward_s=forward_s, reply_s=reply_s,
                 batch_size=req.batch_size, bucket=req.bucket,
-                status=status, transport=req.transport)
+                status=status, transport=req.transport,
+                model_version=req.model_version,
+                model_round=req.model_round,
+                staleness_s=req.staleness_s)
         except Exception:
             pass
 
@@ -674,10 +706,16 @@ class InferenceGateway:
                 {"error": next((r.error or "timeout") for r in reqs
                                if r.error or r.result is None)}
             ).encode("utf-8"), "application/json")
+        stale = self.replica.staleness_s()
         out = {"outputs": [np.asarray(r.result).tolist() for r in reqs],
                "version": self.replica.version,
                "round": self.replica.last_round(),
-               "batch_sizes": [r.batch_size for r in reqs]}
+               "batch_sizes": [r.batch_size for r in reqs],
+               # freshness provenance (additive — old clients that only
+               # read outputs/version/round are untouched)
+               "staleness_s": (None if stale == float("inf")
+                               else round(float(stale), 3)),
+               "layer_rounds": self.replica.layer_rounds()}
         payload = json.dumps(out).encode("utf-8")
         self._account_wire("http", "tx", len(payload))
         return (200, payload, "application/json")
@@ -710,3 +748,23 @@ class InferenceGateway:
             bind_host, int(port), health_fn=health,
             post_routes={"/infer": self.infer_route},
             thread_name="serve-http")
+
+    def register_with_scheduler(self, scheduler_addr, http_port: int,
+                                host: str = "127.0.0.1",
+                                tag: str = "gateway",
+                                heartbeat_interval_s: Optional[float]
+                                = None):
+        """Join the scheduler roster as node kind ``"serve"`` (the
+        registered port IS the node's HTTP surface, so FleetScope
+        discovery needs no side-channel config) and start the standard
+        heartbeat — a dead gateway becomes a *named* death in the
+        scheduler's ``/healthz`` instead of silently missing traffic.
+        Returns the :class:`SchedulerClient`; the caller owns
+        ``close()``."""
+        from geomx_tpu.service.scheduler import SchedulerClient
+        client = SchedulerClient((str(scheduler_addr[0]),
+                                  int(scheduler_addr[1])))
+        client.register("serve", host=host, port=int(http_port),
+                        tag=str(tag))
+        client.start_heartbeat(heartbeat_interval_s)
+        return client

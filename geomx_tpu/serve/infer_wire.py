@@ -11,7 +11,9 @@ ONE persistent connection per client:
   decodes as a zero-copy ``np.frombuffer`` view straight into the
   gateway's queue (the batch assembler's row copy is the only copy);
 - reply: ``Msg(INFER_REPLY, meta={"rid", "version", "round",
-  "batch_sizes", "wire_declared"}, array=float32[rows, out])`` — or an
+  "batch_sizes", "staleness_s", "layer_rounds", "wire_declared"},
+  array=float32[rows, out])`` — the freshness-provenance keys are
+  additive (old clients ignore them: mixed-fleet safe) — or an
   error meta (``shed`` / ``timeout`` / the exception repr) instead of
   a torn socket, mirroring the registry's ERROR-frame discipline;
 - both directions land in the process-global RequestLedger's byte-true
@@ -176,11 +178,20 @@ class NativeInferenceServer:
             return True
         out = np.ascontiguousarray(
             np.stack([np.asarray(r.result) for r in reqs]), np.float32)
+        stale = gw.replica.staleness_s()
         tx = send_frame(conn, Msg(
             MsgType.INFER_REPLY, key="infer", sender=-1,
+            # staleness_s + layer_rounds are additive freshness
+            # provenance: the v0x02 TLV meta codec ships unknown keys
+            # through its generic fallback, so an old client decodes
+            # the frame unchanged and simply ignores them (mixed-fleet
+            # safe — pinned by test_infer_reply_provenance_wire_safe)
             meta={"rid": rid, "version": gw.replica.version,
                   "round": gw.replica.last_round(),
                   "batch_sizes": [r.batch_size for r in reqs],
+                  "staleness_s": (None if stale == float("inf")
+                                  else float(stale)),
+                  "layer_rounds": gw.replica.layer_rounds(),
                   "wire_declared": int(out.nbytes)},
             array=out))
         _account("tx", tx, declared=int(out.nbytes))
@@ -222,7 +233,8 @@ class NativeInferenceClient:
     def infer(self, x: np.ndarray, retries: int = 1) -> dict:
         """One inference batch (``[rows, feat]`` float32; a single row
         is auto-batched).  Returns ``{"outputs": float32[rows, out],
-        "version", "round", "batch_sizes"}``, or ``{"error": ...}``
+        "version", "round", "batch_sizes", "staleness_s",
+        "layer_rounds"}``, or ``{"error": ...}``
         (plus ``"shed"`` count when shed) — explicit refusal, never a
         dropped request."""
         arr = np.ascontiguousarray(x, np.float32)

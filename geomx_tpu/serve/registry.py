@@ -206,7 +206,14 @@ class ModelRegistry:
                                     "s": int(sender), "rid": rid})
             self._apply_delta_locked(version, layer, int(round_id),
                                      vals, idx, int(sender), rid)
-            return True
+        # fresh apply = the round's "publish" hop in the gradient-to-
+        # inference propagation join (outside the lock, best-effort)
+        try:
+            from geomx_tpu.telemetry.fleetscope import note_propagation
+            note_propagation(int(round_id), "publish")
+        except Exception:
+            pass
+        return True
 
     def _apply_delta_locked(self, version, layer, round_id, vals, idx,
                             sender, rid):

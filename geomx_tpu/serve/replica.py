@@ -180,7 +180,24 @@ class ServingReplica:
             self._last_round = max(self._last_round, int(round_id))
             self.deltas_applied += 1
             self._refresh_mono = time.monotonic()
-            return True
+        # telemetry outside the lock: the per-layer watermark gauge is
+        # how any scrape reader sees sync progress (the map itself was
+        # invisible outside the lock until now), and the propagation
+        # tracker's "apply" hop anchors the gradient-to-inference join
+        try:
+            from geomx_tpu.telemetry.registry import get_registry
+            get_registry().gauge(
+                "geomx_serve_replica_round",
+                "Last training round applied to each serving layer",
+                ("layer",)).labels(layer=layer).set(int(round_id))
+        except Exception:
+            pass
+        try:
+            from geomx_tpu.telemetry.fleetscope import note_propagation
+            note_propagation(int(round_id), "apply")
+        except Exception:
+            pass
+        return True
 
     def sync(self, client: RegistryClient) -> dict:
         """One refresh round-trip: pull everything after our per-layer
@@ -248,6 +265,12 @@ class ServingReplica:
         with self._lock:
             return self._last_round
 
+    def layer_rounds(self) -> Dict[str, int]:
+        """Per-layer applied-round watermarks (a copy) — the freshness
+        provenance both inference doors stamp onto replies."""
+        with self._lock:
+            return dict(self._layer_rounds)
+
     def generation(self) -> Optional[int]:
         with self._lock:
             return self._gen
@@ -272,6 +295,7 @@ class ServingReplica:
             return {"version": self.version, "party": self.party,
                     "layers": len(self._params),
                     "last_round": self._last_round,
+                    "layer_rounds": dict(self._layer_rounds),
                     "generation": self._gen,
                     "staleness_s": (None if staleness == float("inf")
                                     else round(staleness, 3)),

@@ -66,7 +66,11 @@ class GeoScheduler:
         self._assigned: Dict[Tuple[str, str, int, str], int] = {}
         self._roster: Dict[str, list] = {}   # role -> [(id, host, port)]
         self._next = {"server": KOFFSET, "worker": KOFFSET + 1,
-                      "global_server": 8, "global_worker": 9}
+                      "global_server": 8, "global_worker": 9,
+                      # serving plane (gateways/replicas/registries):
+                      # heartbeat-covered like every other role, id
+                      # range far above the training tiers
+                      "serve": 900}
         self._barriers: Dict[str, list] = {}
         # roster epoch (resilience/): bumps on every membership-visible
         # roster mutation — registration (fresh or recovery) and
@@ -191,6 +195,7 @@ class GeoScheduler:
         # GEOMX_METRICS_PORT (0 = ephemeral), else no HTTP surface
         self._metrics_srv = None
         self.metrics_port: Optional[int] = None
+        self.fleetscope = None   # set by _start_metrics_http when armed
         if metrics_port is None:
             # graftlint: disable=GXL006 — host-plane knob
             raw = os.environ.get("GEOMX_METRICS_PORT")
@@ -305,6 +310,8 @@ class GeoScheduler:
             epoch = self._epoch
             roster = {role: len(nodes)
                       for role, nodes in sorted(self._roster.items())}
+            entries = {role: [tuple(e) for e in nodes]
+                       for role, nodes in self._roster.items()}
             shard_map_version = None if self._shard_map is None \
                 else self._shard_map.version
             num_shards = None if self._shard_map is None \
@@ -315,6 +322,18 @@ class GeoScheduler:
         alive = self.heartbeats.alive_nodes()
         dead = [] if self.in_restart_grace() \
             else self.heartbeats.dead_nodes()
+        # a death is a NAME, not a bare id: resolve each dead id back
+        # through the roster so operators (and FleetScope) see which
+        # gateway/shard/party died without a side-channel id map
+        by_id = {int(e[0]): (role, e) for role, es in entries.items()
+                 for e in es}
+        dead_nodes = []
+        for nid in dead:
+            role, e = by_id.get(int(nid), (None, None))
+            dead_nodes.append({
+                "id": int(nid), "role": role,
+                "tag": (str(e[3]) if e is not None and len(e) > 3
+                        else None)})
         out = {
             "status": "ok",
             "roster_epoch": epoch,
@@ -322,6 +341,7 @@ class GeoScheduler:
             "live_parties": len(alive),
             "dead_parties": len(dead),
             "dead_node_ids": dead,
+            "dead_nodes": dead_nodes,
             "restart_grace": self.in_restart_grace(),
             "shard_map_version": shard_map_version,
             "num_shards": num_shards,
@@ -507,11 +527,27 @@ class GeoScheduler:
                 "capacity": log.capacity}).encode("utf-8"),
                 "application/json")
 
+        routes = {"/control": _control}
+        # GEOMX_FLEETSCOPE=1: colocate the fleet aggregator with the
+        # scheduler (the only process that already knows every node)
+        # and serve its versioned document at GET /fleet.  Off by
+        # default — zero threads, zero polls (and no step-jaxpr
+        # surface either way: host-plane only, pinned in test_serve).
+        try:
+            from geomx_tpu.telemetry.fleetscope import \
+                fleetscope_from_config
+            self.fleetscope = fleetscope_from_config(self)
+        except Exception:
+            self.fleetscope = None
+        if self.fleetscope is not None:
+            routes["/fleet"] = self.fleetscope.document_route
         self._metrics_srv = start_http_exporter(
             bind_host, port, health_fn=self.health_snapshot,
-            routes={"/control": _control},
+            routes=routes,
             thread_name="sched-metrics-http")
         self.metrics_port = self._metrics_srv.server_address[1]
+        if self.fleetscope is not None:
+            self.fleetscope.start()
 
     def start(self):
         self._thread.start()
@@ -519,6 +555,11 @@ class GeoScheduler:
 
     def stop(self):
         self._running = False
+        if getattr(self, "fleetscope", None) is not None:
+            try:
+                self.fleetscope.stop()
+            except Exception:
+                pass
         try:
             self._srv.close()
         except OSError:

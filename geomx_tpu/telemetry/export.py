@@ -216,6 +216,33 @@ def parse_prometheus_text(text: str) -> Dict[str, Dict[str, Any]]:
 # had to speak the wire protocol to reach a shard's registry)
 # ---------------------------------------------------------------------------
 
+def ledger_document(summary_only: bool = False,
+                    max_records: int = 0) -> Dict[str, Any]:
+    """The ``GET /ledger`` body: round-ledger records + summary, plus
+    the serving plane's request section when a request ledger exists.
+    ``summary=1`` drops the record arrays entirely and ``n=K`` bounds
+    them to the most recent K — FleetScope polls every interval, and
+    shipping the full ring each tick is O(GEOMX_LEDGER_ROUNDS) of JSON
+    per node per poll."""
+    from geomx_tpu.telemetry.ledger import (get_round_ledger,
+                                            peek_request_ledger)
+
+    def _section(led) -> Dict[str, Any]:
+        sec: Dict[str, Any] = {"summary": led.summary()}
+        if not summary_only:
+            recs = led.records()
+            if max_records > 0:
+                recs = recs[-max_records:]
+            sec["records"] = recs
+        return sec
+
+    doc = _section(get_round_ledger())
+    req_led = peek_request_ledger()
+    if req_led is not None:
+        doc["requests"] = _section(req_led)
+    return doc
+
+
 def start_http_exporter(bind_host: str, port: int, health_fn=None,
                         routes: Optional[Dict[str, Any]] = None,
                         post_routes: Optional[Dict[str, Any]] = None,
@@ -225,7 +252,9 @@ def start_http_exporter(bind_host: str, port: int, health_fn=None,
     process-global registry), ``GET /healthz`` (``health_fn()`` as
     JSON), and ``GET /ledger`` (the process-global fleet round
     ledger's records + summary plus the serving plane's per-request
-    ledger when one exists, telemetry/ledger.py).  ``routes`` maps
+    ledger when one exists, telemetry/ledger.py; ``?summary=1`` drops
+    the record arrays, ``?n=K`` bounds them — the FleetScope poll
+    shapes).  ``routes`` maps
     extra GET paths to zero-arg callables returning ``(body_bytes,
     content_type)`` (the scheduler adds ``/control``); ``post_routes``
     maps POST paths to one-arg callables ``body_bytes -> (status,
@@ -240,7 +269,8 @@ def start_http_exporter(bind_host: str, port: int, health_fn=None,
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(h):  # noqa: N805 — http.server handler convention
-            route = h.path.partition("?")[0].rstrip("/")
+            route, _, query = h.path.partition("?")
+            route = route.rstrip("/")
             try:
                 if route in ("", "/metrics"):
                     body = render_prometheus().encode("utf-8")
@@ -250,16 +280,16 @@ def start_http_exporter(bind_host: str, port: int, health_fn=None,
                         health_fn(), default=_json_default).encode("utf-8")
                     ctype = "application/json"
                 elif route == "/ledger":
-                    from geomx_tpu.telemetry.ledger import (
-                        get_round_ledger, peek_request_ledger)
-                    led = get_round_ledger()
-                    doc = {"records": led.records(),
-                           "summary": led.summary()}
-                    req_led = peek_request_ledger()
-                    if req_led is not None:
-                        doc["requests"] = {
-                            "records": req_led.records(),
-                            "summary": req_led.summary()}
+                    from urllib.parse import parse_qs
+                    params = parse_qs(query)
+                    summary_only = params.get(
+                        "summary", ["0"])[-1] in ("1", "true", "yes")
+                    try:
+                        max_records = int(params.get("n", ["0"])[-1])
+                    except ValueError:
+                        max_records = 0
+                    doc = ledger_document(summary_only=summary_only,
+                                          max_records=max_records)
                     body = _json.dumps(
                         doc, default=_json_default).encode("utf-8")
                     ctype = "application/json"

@@ -657,7 +657,10 @@ class RequestLedger:
     def observe(self, rid: int, *, t_enqueue: float, queue_s: float,
                 forward_s: float, reply_s: float, batch_size: int,
                 bucket: int, status: str = "ok",
-                transport: Optional[str] = None) -> None:
+                transport: Optional[str] = None,
+                model_version: Optional[str] = None,
+                model_round: Optional[int] = None,
+                staleness_s: Optional[float] = None) -> None:
         rec = {"rid": int(rid), "t_enqueue": float(t_enqueue),
                "queue_s": float(queue_s), "forward_s": float(forward_s),
                "reply_s": float(reply_s),
@@ -667,6 +670,15 @@ class RequestLedger:
                "status": str(status)}
         if transport is not None:
             rec["transport"] = str(transport)
+        # freshness provenance (gateway dispatch stamps these from the
+        # weight set the batch actually ran on); optional so non-serving
+        # observers and old call sites stay untouched
+        if model_version is not None:
+            rec["model_version"] = str(model_version)
+        if model_round is not None:
+            rec["model_round"] = int(model_round)
+        if staleness_s is not None:
+            rec["staleness_s"] = float(staleness_s)
         with self._lock:
             self._records.append(rec)
             self.observed_total += 1
@@ -745,6 +757,18 @@ class RequestLedger:
             out["batch_size_mean"] = \
                 sum(r["batch_size"] for r in ok) / len(ok)
             out["batch_size_max"] = max(r["batch_size"] for r in ok)
+        # freshness rollup over records carrying provenance — what the
+        # gateway's dispatch stamped, so "staleness served" not
+        # "staleness now"
+        prov = [r for r in ok if "model_round" in r]
+        if prov:
+            out["freshness"] = {
+                "records": len(prov),
+                "model_round_min": min(r["model_round"] for r in prov),
+                "model_round_max": max(r["model_round"] for r in prov),
+                "staleness_max_s": max(
+                    (r["staleness_s"] for r in prov
+                     if "staleness_s" in r), default=None)}
         return out
 
 

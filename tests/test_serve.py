@@ -672,6 +672,9 @@ def test_serve_knobs_from_env(monkeypatch):
     monkeypatch.setenv("GEOMX_SERVE_TIMEOUT_S", "12.5")
     monkeypatch.setenv("GEOMX_SERVE_WARMUP", "0")
     monkeypatch.setenv("GEOMX_SERVE_NATIVE_WIRE", "0")
+    monkeypatch.setenv("GEOMX_FLEETSCOPE", "1")
+    monkeypatch.setenv("GEOMX_FLEETSCOPE_INTERVAL_S", "0.5")
+    monkeypatch.setenv("GEOMX_FLEETSCOPE_BURN_WINDOWS", "30:2,120:1")
     cfg = GeoConfig.from_env()
     assert cfg.serve_port == 9090
     assert cfg.serve_max_batch == 32
@@ -680,6 +683,9 @@ def test_serve_knobs_from_env(monkeypatch):
     assert cfg.serve_timeout_s == 12.5
     assert cfg.serve_warmup is False
     assert cfg.serve_native_wire is False
+    assert cfg.fleetscope is True
+    assert cfg.fleetscope_interval_s == 0.5
+    assert cfg.fleetscope_burn_windows == "30:2,120:1"
     # the gateway's default request deadline comes from the same knob
     rep = ServingReplica("v1")
     gw = InferenceGateway(rep, treedef=None,
@@ -713,7 +719,10 @@ def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
                         serve_staleness_s=cfg.serve_staleness_s,
                         serve_timeout_s=cfg.serve_timeout_s,
                         serve_warmup=cfg.serve_warmup,
-                        serve_native_wire=cfg.serve_native_wire)
+                        serve_native_wire=cfg.serve_native_wire,
+                        fleetscope=cfg.fleetscope,
+                        fleetscope_interval_s=cfg.fleetscope_interval_s,
+                        fleetscope_burn_windows=cfg.fleetscope_burn_windows)
         return Trainer(MLP(num_classes=10, hidden=(32,)), topo,
                        optax.sgd(0.1), sync=get_sync_algorithm(cfg),
                        config=cfg, donate=False)
@@ -721,7 +730,9 @@ def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
     for var in ("GEOMX_SERVE_PORT", "GEOMX_SERVE_MAX_BATCH",
                 "GEOMX_SERVE_QUEUE_MS", "GEOMX_SERVE_STALENESS_S",
                 "GEOMX_SERVE_TIMEOUT_S", "GEOMX_SERVE_WARMUP",
-                "GEOMX_SERVE_NATIVE_WIRE"):
+                "GEOMX_SERVE_NATIVE_WIRE", "GEOMX_FLEETSCOPE",
+                "GEOMX_FLEETSCOPE_INTERVAL_S",
+                "GEOMX_FLEETSCOPE_BURN_WINDOWS"):
         monkeypatch.delenv(var, raising=False)
     tr = build()
     rng = np.random.RandomState(0)
@@ -740,6 +751,9 @@ def test_serve_knobs_keep_jaxpr_byte_identical(monkeypatch):
     monkeypatch.setenv("GEOMX_SERVE_TIMEOUT_S", "5.0")
     monkeypatch.setenv("GEOMX_SERVE_WARMUP", "0")
     monkeypatch.setenv("GEOMX_SERVE_NATIVE_WIRE", "0")
+    monkeypatch.setenv("GEOMX_FLEETSCOPE", "1")
+    monkeypatch.setenv("GEOMX_FLEETSCOPE_INTERVAL_S", "0.25")
+    monkeypatch.setenv("GEOMX_FLEETSCOPE_BURN_WINDOWS", "30:2")
     tr2 = build()
     j_serving = canonicalize_jaxpr(
         str(jax.make_jaxpr(tr2.train_step)(state, xb, yb)))

@@ -92,6 +92,8 @@ DIRECTION = {
     "serve_p99_ms_http": "down",
     "batch_fill_fraction": "up",
     "native_honesty_ratio": "down",
+    "propagation_p50_s": "down",
+    "propagation_p99_s": "down",
 }
 
 
@@ -297,6 +299,22 @@ def extract_metrics(doc: dict) -> Dict[str, Any]:
             if isinstance(rec.get(k), (int, float)):
                 out[k] = float(rec[k])
         return out
+    if rec.get("mode") == "compare_fleetscope":  # FLEETSCOPE_r*
+        for gate in ("ok", "fleetscope_armed", "fleet_route_ok",
+                     "propagation_measured",
+                     "propagation_both_transports", "death_named",
+                     "propagation_spike_bounded", "degrade_ok",
+                     "burn_breached", "burn_deterministic",
+                     "gxtop_renders"):
+            if gate in rec:
+                out[gate] = bool(rec[gate])
+        # gradient-to-inference propagation latency: machine-sensitive
+        # but lower is better; the band catches the freshness join
+        # degrading (e.g. the serve hop decoupling from the publish)
+        for k in ("propagation_p50_s", "propagation_p99_s"):
+            if isinstance(rec.get(k), (int, float)):
+                out[k] = float(rec[k])
+        return out
     if rec.get("mode") == "compare_control":  # CONTROL_r*
         for gate in ("controller_beats_all_static",
                      "decision_log_deterministic",
@@ -437,7 +455,7 @@ def run(repo_dir: str, band: float = DEFAULT_BAND,
                             "RECOVERY_r*.json", "MANYPARTY_r*.json",
                             "SPARSEAGG_r*.json", "FLEETOBS_r*.json",
                             "CAPSULE_r*.json", "TRANSFORMER_r*.json",
-                            "SERVE_r*.json"]
+                            "SERVE_r*.json", "FLEETSCOPE_r*.json"]
     series: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     raw_docs: Dict[str, List[dict]] = {}
     unreadable: List[str] = []

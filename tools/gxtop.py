@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""gxtop: render a FleetScope fleet document as a terminal dashboard.
+
+Reads the versioned fleet document — from the scheduler's ``GET
+/fleet`` route (``--url``) or a JSON file (``--file``, CI artifacts) —
+and renders per-node health, fleet rollups, gradient-to-inference
+propagation latency, burn-rate state and recent health transitions as
+one text snapshot.  ``--watch`` redraws every ``--interval`` seconds;
+``--json`` dumps the raw document (the CI path).
+
+Stdlib only, no geomx_tpu import: the tool must run on an operator
+laptop against a remote scheduler with nothing installed.
+
+Usage:
+    python tools/gxtop.py --url=http://127.0.0.1:9100/fleet
+    python tools/gxtop.py --url=http://127.0.0.1:9100/fleet --watch
+    python tools/gxtop.py --file=out/FLEETSCOPE_fleet.json --json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+HEALTH_ORDER = {"dead": 0, "stale": 1, "ok": 2}
+
+
+def fetch_document(url=None, path=None, timeout_s=5.0) -> dict:
+    if (url is None) == (path is None):
+        raise ValueError("pass exactly one of --url / --file")
+    if url is not None:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _table(rows, headers) -> str:
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              if rows else len(str(h))
+              for i, h in enumerate(headers)]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def render(doc: dict) -> str:
+    lines = []
+    roll = doc.get("rollups") or {}
+    prop = doc.get("propagation") or {}
+    burn = doc.get("burn") or {}
+    lines.append(
+        f"fleet v{doc.get('fleet_version', 0)}  "
+        f"nodes ok/stale/dead: {roll.get('nodes_ok', 0)}/"
+        f"{roll.get('nodes_stale', 0)}/{roll.get('nodes_dead', 0)}  "
+        f"qps {_fmt(roll.get('qps'), 1)}  "
+        f"shed {_fmt(roll.get('shed_rate'), 4)}  "
+        f"burn {_fmt(roll.get('burn_rate_max'), 2)}"
+        f"{'  BREACHED' if burn.get('breached') else ''}")
+    lines.append(
+        f"request p50/p99 {_fmt(roll.get('request_p50_s'))}/"
+        f"{_fmt(roll.get('request_p99_s'))} s   "
+        f"honesty max {_fmt(roll.get('honesty_ratio_max'), 4)}   "
+        f"replica staleness max "
+        f"{_fmt(roll.get('replica_staleness_max_s'))} s")
+    lines.append(
+        f"propagation (gradient->inference) p50/p99 "
+        f"{_fmt(prop.get('p50_s'))}/{_fmt(prop.get('p99_s'))} s  "
+        f"over {prop.get('rounds_completed', 0)}/"
+        f"{prop.get('rounds_tracked', 0)} rounds  "
+        f"by transport {prop.get('by_transport') or {}}")
+    lines.append("")
+    nodes = doc.get("nodes") or {}
+    rows = []
+    for name in sorted(nodes, key=lambda n: (
+            HEALTH_ORDER.get(nodes[n].get("health"), 3), n)):
+        e = nodes[name]
+        rows.append((name, e.get("kind", "-"), e.get("health", "-"),
+                     _fmt(e.get("confidence"), 2),
+                     _fmt(e.get("age_s"), 1),
+                     e.get("reason") or "",
+                     _fmt(e.get("request_p99_s"))))
+    lines.append(_table(rows, ("node", "kind", "health", "conf",
+                               "age_s", "reason", "req_p99_s")))
+    transitions = doc.get("transitions") or []
+    if transitions:
+        lines.append("")
+        lines.append("recent transitions:")
+        for t in transitions[-8:]:
+            lines.append(
+                f"  {t.get('node')}: {t.get('from')} -> {t.get('to')}"
+                f" ({t.get('reason') or 'n/a'})")
+    breaches = burn.get("breaches") or []
+    if breaches:
+        lines.append("")
+        lines.append(f"burn breaches: {len(breaches)} "
+                     f"(last max_burn "
+                     f"{_fmt(breaches[-1].get('max_burn'), 2)})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    url = path = None
+    watch = as_json = False
+    interval = 2.0
+    for arg in argv:
+        if arg.startswith("--url="):
+            url = arg.split("=", 1)[1]
+        elif arg.startswith("--file="):
+            path = arg.split("=", 1)[1]
+        elif arg.startswith("--interval="):
+            interval = float(arg.split("=", 1)[1])
+        elif arg == "--watch":
+            watch = True
+        elif arg == "--json":
+            as_json = True
+        elif arg in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            print(f"gxtop: unknown argument {arg!r}", file=sys.stderr)
+            return 2
+    try:
+        while True:
+            doc = fetch_document(url=url, path=path)
+            if as_json:
+                print(json.dumps(doc, indent=2, sort_keys=True))
+            else:
+                if watch:
+                    # clear + home, ANSI — a live top-style redraw
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(render(doc))
+                sys.stdout.flush()
+            if not watch:
+                return 0
+            time.sleep(max(0.1, interval))
+    except KeyboardInterrupt:
+        return 0
+    except (OSError, ValueError) as e:
+        print(f"gxtop: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
